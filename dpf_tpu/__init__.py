@@ -1,0 +1,15 @@
+"""dpf_tpu — a TPU-native Distributed Point Function / 2-server PIR framework.
+
+Same capabilities as facebookresearch/GPU-DPF, re-designed for TPU
+(JAX / XLA / shard_map): client-side O(log N) GGM key generation
+with ~2 KB keys, server-side batched key expansion under
+AES-128 / Salsa20-12 / ChaCha20-12 / DUMMY PRFs over 4x-uint32 limb
+arithmetic, a fused leaf x table contraction (exact mod-2^32 int32 matmul),
+and table row-sharding across a device mesh with psum share reduction.
+"""
+
+from .api import DPF  # noqa: F401
+from .core.prf_ref import (  # noqa: F401
+    PRF_AES128, PRF_CHACHA20, PRF_DUMMY, PRF_SALSA20)
+
+__version__ = "0.1.0"

@@ -1,0 +1,222 @@
+"""User-facing DPF API — drop-in compatible with the reference's ``dpf.DPF``.
+
+Mirrors the surface of the reference Python API (``dpf.py:35-137`` +
+``dpf_wrapper.cu:188-204``): ``gen``/``eval_init``/``eval_gpu``/``eval_cpu``/
+``eval_free``, constants ``ENTRY_SIZE``/``BATCH_SIZE``/``PRF_*``, 524-int32
+(2096 B) keys — but the server eval path is a jitted JAX program on TPU
+(``eval_tpu``; ``eval_gpu`` is kept as an alias so reference scripts run
+unmodified).
+
+Tables are accepted as torch tensors (CPU), NumPy arrays, or anything
+array-like; results come back as torch tensors when torch supplied the
+inputs, NumPy arrays otherwise.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from .core import evalref, expand, keygen
+from .core.prf_ref import (PRF_AES128, PRF_CHACHA20, PRF_DUMMY, PRF_NAMES,
+                           PRF_SALSA20)
+
+
+def _to_numpy(x, dtype=None):
+    if hasattr(x, "detach"):  # torch tensor
+        x = x.detach().cpu().numpy()
+    arr = np.asarray(x)
+    if dtype is not None and arr.dtype != dtype:
+        arr = arr.astype(dtype)
+    return arr
+
+
+def _maybe_torch(arr, like_torch: bool):
+    if like_torch:
+        try:
+            import torch
+            return torch.from_numpy(np.ascontiguousarray(arr))
+        except ImportError:
+            pass
+    return arr
+
+
+def _is_torch(x) -> bool:
+    return hasattr(x, "detach")
+
+
+def _native_gen(k, n, seed, prf_method):
+    """Native keygen fast path (byte-identical to the Python DRBG)."""
+    try:
+        from . import native
+        return native.gen(k, n, seed, prf_method)
+    except Exception:
+        return None
+
+
+def _native_expand_batch(keys, prf_method):
+    """Native full-expansion fast path; None to fall back to NumPy."""
+    try:
+        from . import native
+        if not native.available():
+            return None
+        return np.stack([native.eval_expand(_to_numpy(k, np.int32),
+                                            prf_method) for k in keys])
+    except Exception:
+        return None
+
+
+class DPF(object):
+    """Two-server DPF with TPU-accelerated server-side evaluation."""
+
+    PRF_DUMMY = PRF_DUMMY
+    PRF_SALSA20 = PRF_SALSA20
+    PRF_CHACHA20 = PRF_CHACHA20
+    PRF_AES128 = PRF_AES128
+
+    ENTRY_SIZE = 16       # int32 words per entry (reference parity)
+    BATCH_SIZE = 512      # max keys per device dispatch (reference parity)
+    MIN_ENTRIES = 128
+
+    DEFAULT_PRF = PRF_AES128
+
+    def __init__(self, prf=None, strict=True):
+        self.prf_method = self.DEFAULT_PRF if prf is None else prf
+        self.prf_method_string = PRF_NAMES[self.prf_method]
+        self.strict = strict          # enforce reference shape limits
+        self.table = None             # original table (numpy int32)
+        self.table_device = None      # permuted table on device (jnp)
+        self.table_num_entries = None
+        self.table_effective_entry_size = None
+        self._torch_io = False
+        self.buffers = None           # reference-API compat handle
+
+    # ------------------------------------------------------------------ gen
+
+    def gen(self, k, n, seed: bytes | None = None):
+        """Generate the two servers' keys for secret index k in [0, n)."""
+        if n & (n - 1) != 0:
+            raise ValueError(
+                "Table num entries (%d) must be a power of two" % n)
+        if k >= n:
+            raise ValueError(
+                "k (%d), the selected element, must be less than n (%d), "
+                "the number of entries in the table" % (k, n))
+        if seed is None:
+            seed = os.urandom(128)
+        native_keys = _native_gen(k, n, seed, self.prf_method)
+        if native_keys is not None:
+            s0, s1 = native_keys
+        else:
+            k0, k1 = keygen.generate_keys(k, n, seed, self.prf_method)
+            s0, s1 = k0.serialize(), k1.serialize()
+        return _maybe_torch(s0, True), _maybe_torch(s1, True)
+
+    # ----------------------------------------------------------- eval_init
+
+    def eval_init(self, table):
+        """Upload a [N, E] integer table; pre-permutes rows for BFS order."""
+        self._torch_io = _is_torch(table)
+        tbl = _to_numpy(table, np.int32)
+        if tbl.ndim != 2:
+            raise ValueError("table must be 2D [entries, entry_size]")
+        n, e = tbl.shape
+        if n < self.MIN_ENTRIES:
+            raise ValueError(
+                "Table (%d) must have at least %d elements"
+                % (n, self.MIN_ENTRIES))
+        if n & (n - 1) != 0:
+            raise ValueError(
+                "Table num entries (%d) must be a power of two" % n)
+        if self.strict and e > self.ENTRY_SIZE:
+            raise ValueError(
+                "Table entry dimension (%d) must be <= %d "
+                "(pass strict=False to lift)" % (e, self.ENTRY_SIZE))
+
+        import jax.numpy as jnp
+        self.table = tbl
+        self.table_num_entries = n
+        self.table_effective_entry_size = e
+        self.table_device = jnp.asarray(expand.permute_table(tbl))
+        self.buffers = (self.table_device,)
+        return self.buffers
+
+    # ------------------------------------------------------------ eval_tpu
+
+    def eval_tpu(self, keys):
+        """Batched server evaluation on the accelerator.
+
+        keys: list of serialized key tensors ([524] int32 each).
+        Returns [len(keys), entry_size] int32 shares.
+        """
+        if self.table_device is None:
+            raise RuntimeError("Must call `eval_init` before `eval_tpu`")
+        eff = len(keys)
+        if eff == 0:
+            raise ValueError("empty key batch")
+        results = []
+        for i in range(0, eff, self.BATCH_SIZE):
+            cur = keys[i:i + self.BATCH_SIZE]
+            # pad to the next power of two (bounded compile-cache churn,
+            # reference pads to a fixed 512: dpf.py:123-126)
+            padded = 1
+            while padded < len(cur):
+                padded *= 2
+            cur = cur + [cur[-1]] * (padded - len(cur))
+            results.append(self._eval_batch(cur))
+        out = np.concatenate(results)[:eff, :self.table_effective_entry_size]
+        return _maybe_torch(out, self._torch_io)
+
+    # Reference scripts call eval_gpu; on this framework that IS the TPU.
+    eval_gpu = eval_tpu
+
+    def _eval_batch(self, keys) -> np.ndarray:
+        flat = [keygen.deserialize_key(k) for k in keys]
+        n = self.table_num_entries
+        for fk in flat:
+            if fk.n != n:
+                raise ValueError(
+                    "key generated for n=%d but table has n=%d" % (fk.n, n))
+        cw1, cw2, last = expand.pack_keys(flat)
+        depth = n.bit_length() - 1
+        chunk = expand.choose_chunk(n, len(flat))
+        out = expand.expand_and_contract(
+            cw1, cw2, last, self.table_device, depth=depth,
+            prf_method=self.prf_method, chunk_leaves=chunk)
+        return np.asarray(out)
+
+    # ------------------------------------------------------------ eval_cpu
+
+    def eval_cpu(self, keys, one_hot_only=False):
+        """Host reference evaluation (native C++ when available, else
+        vectorized NumPy breadth-first)."""
+        torch_io = any(_is_torch(k) for k in keys)
+        hots = _native_expand_batch(keys, self.prf_method)
+        if hots is None:
+            flat = [keygen.deserialize_key(k) for k in keys]
+            hots = np.stack([evalref.eval_one_hot_i32(fk, self.prf_method)
+                             for fk in flat])  # [B, N] int32
+        if one_hot_only:
+            return _maybe_torch(hots, torch_io)
+        if self.table is None:
+            raise RuntimeError(
+                "Must call `eval_init` before `eval_cpu` with "
+                "one_hot_only=False")
+        # exact wrapping mod-2^32 matmul on host
+        prod = hots.astype(np.uint32) @ self.table.view(np.uint32)
+        return _maybe_torch(prod.view(np.int32), torch_io or self._torch_io)
+
+    # ------------------------------------------------------------ eval_free
+
+    def eval_free(self, buffers=None):
+        self.table_device = None
+        self.buffers = None
+
+    def __repr__(self):
+        if self.table_device is None:
+            return ("DPF(_uninitialized_, prf_method=%s)"
+                    % self.prf_method_string)
+        return ("DPF(entries=%d, entry_size=%d, prf_method=%s)"
+                % (self.table_num_entries, self.table_effective_entry_size,
+                   self.prf_method_string))

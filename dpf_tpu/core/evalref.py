@@ -1,0 +1,50 @@
+"""Vectorized host-side DPF evaluation (NumPy breadth-first expansion).
+
+This is the framework's fast CPU path (`DPF.eval_cpu`) and the differential
+oracle for the TPU path: it expands a key over all N leaves level-by-level
+exactly like the TPU program, but in NumPy.
+
+Breadth-first recurrence (reference ``dpf_gpu/dpf_breadth_first.cu:35-53``):
+    new[2j+b] = PRF(old[j], b) + cw[old[j] & 1][2i + b]
+applied from the base flat level (i = depth-1, consumes alpha bit 0) upward,
+so BFS leaf position p holds natural index bit_reverse(p).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import u128
+from .keygen import FlatKey
+from .prf import prf_v
+
+
+def expand_bfs(key: FlatKey, prf_method: int) -> np.ndarray:
+    """Expand one key to all leaves in BFS (bit-reversed) order.
+
+    Returns [n, 4] uint32 limb array of the server's 128-bit output shares.
+    """
+    seeds = u128.int_to_limbs(key.last_key)[None, :]  # [1, 4]
+    for i in range(key.depth - 1, -1, -1):
+        sel = (seeds[:, 0] & 1).astype(bool)  # [w] codeword row per node
+        children = []
+        for b in range(2):
+            cw = np.where(sel[:, None], key.cw2[2 * i + b],
+                          key.cw1[2 * i + b])  # [w, 4]
+            children.append(u128.add128(prf_v(prf_method, seeds, b), cw))
+        # interleave: new[2j+b] = children[b][j]
+        seeds = np.stack(children, axis=1).reshape(-1, 4)
+    return seeds
+
+
+def eval_one_hot_i32(key: FlatKey, prf_method: int) -> np.ndarray:
+    """Server share of the one-hot vector, natural order, low 32 bits.
+
+    Matches the reference's ``eval_cpu`` output (``dpf_wrapper.cu:70-84``):
+    int32 truncation of each 128-bit leaf share.
+    """
+    leaves = expand_bfs(key, prf_method)  # BFS order
+    lo = leaves[:, 0]  # low limb
+    perm = u128.bit_reverse_indices(1 << key.depth)
+    # natural[j] = bfs[bit_reverse(j)]
+    return lo[perm].view(np.int32)

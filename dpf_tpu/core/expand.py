@@ -1,0 +1,155 @@
+"""TPU-side batched DPF expansion with fused table contraction.
+
+Design (SURVEY.md §7): on TPU the natural formulation is breadth-first
+everywhere.  The GGM level recurrence
+
+    new[2j+b] = PRF(old[j], b) + cw[old[j] & 1][2i + b]        (mod 2^128)
+
+runs as elementwise uint32-limb ops over a ``[B, width, 4]`` seed tensor.
+To bound memory at large N (the role of the reference's DFS "hybrid" kernel,
+``dpf_gpu/dpf/dpf_hybrid.cu``), expansion is split in two phases:
+
+* **Phase 1**: expand all B keys from the root to a frontier of F nodes
+  (full materialization, F small).
+* **Phase 2**: ``lax.scan`` over the F frontier nodes; each step expands one
+  node's subtree to its C = N/F leaves and immediately contracts against the
+  matching table rows, accumulating into the output — O(B * C) live memory.
+
+The contraction exploits that the protocol truncates shares to int32
+(``dpf_wrapper.cu:178-185``): mod 2^32, the 128-bit leaf x entry product
+reduces to ``lo32(leaf) * entry``, so the fused dot is an exact wrapping
+int32 matmul — no 128-bit GEMM needed on the server at all.  (The reference
+burns a custom split-K uint128 GEMM on this, ``dpf_gpu/matmul/matmul.cu``.)
+
+Leaves emerge in bit-reversed order; the table is pre-permuted once at init
+(`permute_table`), exactly as the reference does (``dpf_wrapper.cu:104-109``).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import u128
+from .prf import prf_v
+
+MAX_CW = 64  # codeword slots in the wire format (2 per level, depth <= 32)
+
+
+def choose_chunk(n: int, batch: int) -> int:
+    """Leaves per phase-2 step: keep the live seed tensor ~32 MB."""
+    target = max(256, (1 << 22) // max(1, batch))
+    c = 1
+    while c * 2 <= min(n, target):
+        c *= 2
+    return c
+
+
+def _level_step(seeds, cw1, cw2, i: int, prf_method: int):
+    """One GGM level: [B, w, 4] -> [B, 2w, 4].  `i` is the flat level index."""
+    sel = (seeds[..., 0] & np.uint32(1)).astype(bool)[..., None]  # [B, w, 1]
+    children = []
+    for b in (0, 1):
+        cw = jnp.where(sel, cw2[:, None, 2 * i + b, :],
+                       cw1[:, None, 2 * i + b, :])        # [B, w, 4]
+        children.append(u128.add128(prf_v(prf_method, seeds, b), cw))
+    stacked = jnp.stack(children, axis=2)                 # [B, w, 2, 4]
+    bsz, w = seeds.shape[0], seeds.shape[1]
+    return stacked.reshape(bsz, 2 * w, 4)
+
+
+def permute_table(table_i32: np.ndarray) -> np.ndarray:
+    """Bit-reverse-permute table rows once at init (host side)."""
+    n = table_i32.shape[0]
+    return np.ascontiguousarray(table_i32[u128.bit_reverse_indices(n)])
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "prf_method",
+                                             "chunk_leaves"))
+def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
+                        prf_method: int, chunk_leaves: int):
+    """Batched fused DPF evaluation.
+
+    Args:
+      cw1, cw2: [B, 64, 4] uint32 — per-key codeword limb arrays.
+      last:     [B, 4] uint32 — per-key start seeds.
+      table_perm: [N, E] int32 — bit-reverse-permuted table.
+      depth: log2(N); prf_method: static PRF id; chunk_leaves: C.
+
+    Returns [B, E] int32 server output shares.
+    """
+    n = table_perm.shape[0]
+    e = table_perm.shape[1]
+    bsz = last.shape[0]
+    c = chunk_leaves
+    f = n // c  # frontier width
+    assert c * f == n and depth == int(np.log2(n))
+
+    seeds = last[:, None, :]  # [B, 1, 4]
+    f_levels = int(np.log2(f))
+    # Phase 1: root -> frontier (levels depth-1 .. depth-f_levels)
+    for l in range(f_levels):
+        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method)
+
+    def expand_subtree(node_seeds):
+        """[B, 4] frontier seeds -> [B, C] low-32 leaf shares."""
+        s = node_seeds[:, None, :]
+        for l in range(f_levels, depth):
+            s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method)
+        return s[..., 0].astype(jnp.int32)  # low limb, [B, C]
+
+    table_chunks = table_perm.reshape(f, c, e)
+
+    if f == 1:
+        leaves = expand_subtree(seeds[:, 0, :])
+        return _dot_i32(leaves, table_chunks[0])
+
+    frontier = jnp.moveaxis(seeds, 1, 0)  # [F, B, 4]
+
+    def body(acc, xs):
+        node_seeds, chunk = xs
+        leaves = expand_subtree(node_seeds)         # [B, C] int32
+        return acc + _dot_i32(leaves, chunk), None
+
+    acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
+    acc, _ = lax.scan(body, acc0, (frontier, table_chunks))
+    return acc
+
+
+def _dot_i32(a, b):
+    """Exact wrapping int32 matmul: [B, C] x [C, E] -> [B, E] mod 2^32."""
+    return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+
+
+def expand_leaves(cw1, cw2, last, *, depth: int, prf_method: int):
+    """Full expansion to [B, N] low-32 leaf shares in natural index order.
+
+    Debug/one-hot path (the reference's breadth-first strategy output,
+    ``dpf_gpu/dpf/dpf_breadth_first.cu:93-103``, de-bit-reversed).
+    Memory O(B * N); use expand_and_contract for large N.
+    """
+    seeds = last[:, None, :]
+    for l in range(depth):
+        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method)
+    lo = seeds[..., 0].astype(jnp.int32)  # [B, N] BFS order
+    perm = u128.bit_reverse_indices(1 << depth)
+    return lo[:, perm]
+
+
+def pack_keys(flat_keys) -> tuple:
+    """List of FlatKey -> (cw1 [B,64,4], cw2, last [B,4]) uint32 arrays."""
+    bsz = len(flat_keys)
+    cw1 = np.zeros((bsz, MAX_CW, 4), dtype=np.uint32)
+    cw2 = np.zeros((bsz, MAX_CW, 4), dtype=np.uint32)
+    last = np.zeros((bsz, 4), dtype=np.uint32)
+    for i, k in enumerate(flat_keys):
+        cw1[i] = k.cw1
+        cw2[i] = k.cw2
+        last[i] = u128.int_to_limbs(k.last_key)
+    return cw1, cw2, last

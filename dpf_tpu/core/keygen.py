@@ -1,0 +1,186 @@
+"""Client-side DPF key generation (log-N GGM construction) + key codec.
+
+Re-derivation of the reference construction (``dpf_base/dpf.h:403-464`` with
+base case ``:290-360``) in iterative, host-side Python.  The construction is
+the reference's seed-LSB-as-control-bit variant of GGM:
+
+* Each tree level ``l`` owns a pair of 128-bit correction words per server
+  view (``cw_1[2i+b]``, ``cw_2[2i+b]`` with flat level index ``i``, branch
+  ``b``); an evaluator walking the tree picks ``cw_1`` vs ``cw_2`` by the
+  *LSB of its current seed*.
+* At the target path the two servers' seeds differ by an odd value (so their
+  LSBs differ and they pick opposite codeword rows); everywhere else seeds
+  are identical and contributions cancel.
+* Index bits are consumed LSB-first: the base level handles bit 0 of alpha
+  (``EvaluateFlat`` semantics, ``dpf_base/dpf.h:362-377``).
+
+Key wire format matches the reference byte-for-byte
+(``dpf_wrapper.cu:26-46``): 524 int32 = 131 uint128 little-endian slots:
+``[0]=depth, [1..64]=cw_1, [65..128]=cw_2, [129]=last_key, [130]=n`` —
+~2 KB per key, tables up to 2^32 entries.
+
+Randomness: the reference seeds ``std::mt19937`` with 32 bits of entropy and
+uses 32-bit draws for some codewords (its own TODO at ``dpf.py:65``); we keep
+the key *format* but draw every secret from a SHAKE-256 XOF over the caller's
+seed — deterministic per seed, full 128-bit masks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import u128
+from .prf_ref import MASK128, PRF_FUNCS
+
+KEY_WORDS = 524          # int32 words per serialized key
+MAX_DEPTH = 32           # => tables up to 2^32 entries
+
+
+class Shake256Drbg:
+    """Deterministic byte stream: SHAKE-256(seed || counter) blocks."""
+
+    def __init__(self, seed: bytes):
+        self._seed = bytes(seed)
+        self._ctr = 0
+        self._buf = b""
+
+    def _refill(self):
+        h = hashlib.shake_256(self._seed + self._ctr.to_bytes(8, "little"))
+        self._ctr += 1
+        self._buf += h.digest(1024)
+
+    def bytes(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            self._refill()
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def u128(self) -> int:
+        return int.from_bytes(self.bytes(16), "little")
+
+    def u128_odd(self) -> int:
+        return self.u128() | 1
+
+
+@dataclass
+class FlatKey:
+    """One server's flattened DPF key (host representation)."""
+    depth: int
+    cw1: np.ndarray      # [64, 4] uint32 limbs (slots beyond 2*depth zero)
+    cw2: np.ndarray      # [64, 4] uint32
+    last_key: int        # 128-bit start seed for this server
+    n: int               # table size the key was generated for
+
+    def serialize(self) -> np.ndarray:
+        """-> [524] int32, reference wire format."""
+        slots = np.zeros((131, 4), dtype=np.uint32)
+        slots[0] = u128.int_to_limbs(self.depth)
+        slots[1:65] = self.cw1
+        slots[65:129] = self.cw2
+        slots[129] = u128.int_to_limbs(self.last_key)
+        slots[130] = u128.int_to_limbs(self.n)
+        return slots.reshape(-1).view(np.int32).copy()
+
+
+def deserialize_key(key) -> FlatKey:
+    """[524] int32 (array-like; torch tensors accepted) -> FlatKey."""
+    arr = np.asarray(key, dtype=np.int32).reshape(-1)
+    if arr.shape[0] != KEY_WORDS:
+        raise ValueError("DPF key must be %d int32 words, got %d"
+                         % (KEY_WORDS, arr.shape[0]))
+    slots = arr.view(np.uint32).reshape(131, 4)
+    return FlatKey(
+        depth=int(slots[0, 0]),
+        cw1=slots[1:65].copy(),
+        cw2=slots[65:129].copy(),
+        last_key=u128.limbs_to_int(slots[129]),
+        n=u128.limbs_to_int(slots[130]),  # n=2^32 spills into limb 1
+    )
+
+
+def generate_keys(alpha: int, n: int, seed: bytes, prf_method: int,
+                  beta: int = 1):
+    """Generate the two servers' keys for point function f(alpha) = beta.
+
+    Returns (FlatKey for server 0, FlatKey for server 1).
+    Cost is O(log N) PRF calls — keygen always stays on host.
+    """
+    if n & (n - 1) != 0 or n < 2:
+        raise ValueError("table size (%d) must be a power of two >= 2" % n)
+    if not 0 <= alpha < n:
+        raise ValueError("alpha (%d) must be in [0, %d)" % (alpha, n))
+    depth = n.bit_length() - 1
+    if depth > MAX_DEPTH:
+        raise ValueError("table size 2^%d exceeds max 2^32" % depth)
+
+    prf = PRF_FUNCS[prf_method]
+    rng = Shake256Drbg(seed)
+
+    cw1 = np.zeros((64, 4), dtype=np.uint32)
+    cw2 = np.zeros((64, 4), dtype=np.uint32)
+
+    def put(arr, i, b, val):
+        arr[2 * i + b] = u128.int_to_limbs(val)
+
+    bits = [(alpha >> l) & 1 for l in range(depth)]
+
+    # --- base level (flat index depth-1) handles bit 0 of alpha ----------
+    k1 = rng.u128() & ~1          # server 0 start seed: LSB 0
+    k2 = rng.u128() | 1           # server 1 start seed: LSB 1
+    beta_l = beta if depth == 1 else rng.u128_odd()
+    i = depth - 1
+    c1 = [rng.u128() for _ in range(2)]
+    for b in range(2):
+        d = (prf(k1, b) - prf(k2, b)) & MASK128
+        if b == bits[0]:
+            d = (d - beta_l) & MASK128
+        put(cw1, i, b, c1[b])
+        put(cw2, i, b, (c1[b] + d) & MASK128)
+    # evaluated seeds at the target path after the base level
+    s1 = (prf(k1, bits[0]) + c1[bits[0]]) & MASK128                 # k1 LSB=0
+    s2 = (prf(k2, bits[0]) + u128.limbs_to_int(cw2[2 * i + bits[0]])) & MASK128
+
+    # --- upper levels, bottom to top --------------------------------------
+    for l in range(1, depth):
+        assert (s1 - s2) & MASK128 == beta_l and (s1 ^ s2) & 1
+        i = depth - 1 - l
+        beta_l = beta if l == depth - 1 else rng.u128_odd()
+        tb = bits[l]
+        s1_even = (s1 & 1) == 0
+        c1 = [rng.u128() for _ in range(2)]
+        for b in range(2):
+            d = (prf(s2, b) - prf(s1, b)) & MASK128
+            if s1_even:
+                d = (-d) & MASK128
+            put(cw2, i, b, (c1[b] + d) & MASK128)
+        # fold beta into cw1 at the target branch (after cw2 is fixed)
+        c1[tb] = (c1[tb] + (beta_l if s1_even else -beta_l)) & MASK128
+        for b in range(2):
+            put(cw1, i, b, c1[b])
+        # step both servers' target-path seeds through this level
+        n1 = (prf(s1, tb) + (c1[tb] if s1_even
+                             else u128.limbs_to_int(cw2[2 * i + tb]))) & MASK128
+        n2 = (prf(s2, tb) + (u128.limbs_to_int(cw2[2 * i + tb]) if s1_even
+                             else c1[tb])) & MASK128
+        s1, s2 = n1, n2
+
+    ka = FlatKey(depth=depth, cw1=cw1, cw2=cw2, last_key=k1, n=n)
+    kb = FlatKey(depth=depth, cw1=cw1.copy(), cw2=cw2.copy(), last_key=k2, n=n)
+    return ka, kb
+
+
+def evaluate_flat(key: FlatKey, indx: int, prf_method: int) -> int:
+    """Scalar reference evaluation at one index (O(log N) PRF calls)."""
+    prf = PRF_FUNCS[prf_method]
+    cur = key.last_key
+    rem = indx
+    for i in range(key.depth - 1, -1, -1):
+        b = rem & 1
+        val = prf(cur, b)
+        cw = key.cw1 if (cur & 1) == 0 else key.cw2
+        cur = (val + u128.limbs_to_int(cw[2 * i + b])) & MASK128
+        rem >>= 1
+    return cur

@@ -1,0 +1,396 @@
+"""Vectorized PRFs over [..., 4]-uint32 limb arrays — the TPU hot path.
+
+Each function maps a batch of 128-bit seeds (trailing axis = 4 little-endian
+uint32 limbs) and a *static* small position ``pos`` (0 or 1 in the GGM walk)
+to a batch of 128-bit PRF outputs, matching the scalar semantics in
+``prf_ref.py`` bit-for-bit.
+
+The implementations are backend generic (NumPy for the host reference path,
+jax.numpy inside jit for TPU): Salsa/ChaCha are pure 32-bit add/xor/rotate
+chains that XLA fuses into long VPU pipelines; AES-128 ships in two flavors —
+a byte-table gather version (simple, used on host) and a *bitsliced* version
+(boolean algebra over 128 bit-planes, no gathers) which is what runs on TPU.
+
+Reference semantics: ``dpf_base/dpf.h:65-235`` and ``dpf_gpu/prf/prf.cu``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from . import u128
+from .prf_ref import PRF_AES128, PRF_CHACHA20, PRF_DUMMY, PRF_SALSA20, SBOX
+
+_SIGMA = (0x65787061, 0x6E642033, 0x322D6279, 0x7465206B)
+
+
+def _const(like, v):
+    """uint32 scalar constant broadcastable against `like`'s backend."""
+    return np.uint32(v)
+
+
+def _rotl(x, b: int):
+    return (x << np.uint32(b)) | (x >> np.uint32(32 - b))
+
+
+# ---------------------------------------------------------------------------
+# DUMMY
+# ---------------------------------------------------------------------------
+
+def prf_dummy_v(seeds, pos: int):
+    """seed * (pos+4242) + (pos+4242) mod 2^128, vectorized."""
+    t = pos + 4242
+    r = u128.mul128_small(seeds, t)
+    tl = np.array(u128.int_to_limbs(t))
+    # broadcast the constant to the seed batch shape via zero-add
+    zero = seeds - seeds
+    tb = zero + tl
+    return u128.add128(r, tb)
+
+
+# ---------------------------------------------------------------------------
+# Salsa20/12 & ChaCha20/12
+# ---------------------------------------------------------------------------
+
+def _salsa_qr(x, a, b, c, d):
+    x[b] = x[b] ^ _rotl(x[a] + x[d], 7)
+    x[c] = x[c] ^ _rotl(x[b] + x[a], 9)
+    x[d] = x[d] ^ _rotl(x[c] + x[b], 13)
+    x[a] = x[a] ^ _rotl(x[d] + x[c], 18)
+
+
+def prf_salsa20_12_v(seeds, pos: int):
+    """12-round Salsa20 core; key = seed words MSW-first in state 1..4."""
+    zero = seeds[..., 0] - seeds[..., 0]
+    x = [zero + _const(seeds, 0)] * 16
+    x[0] = zero + _const(seeds, _SIGMA[0])
+    x[5] = zero + _const(seeds, _SIGMA[1])
+    x[10] = zero + _const(seeds, _SIGMA[2])
+    x[15] = zero + _const(seeds, _SIGMA[3])
+    # seed limbs are little-endian; state words 1..4 take MSW..LSW
+    x[1] = seeds[..., 3]
+    x[2] = seeds[..., 2]
+    x[3] = seeds[..., 1]
+    x[4] = seeds[..., 0]
+    x[8] = zero + _const(seeds, (pos >> 32) & 0xFFFFFFFF)
+    x[9] = zero + _const(seeds, pos & 0xFFFFFFFF)
+    init = list(x)
+    for _ in range(6):
+        _salsa_qr(x, 0, 4, 8, 12)
+        _salsa_qr(x, 5, 9, 13, 1)
+        _salsa_qr(x, 10, 14, 2, 6)
+        _salsa_qr(x, 15, 3, 7, 11)
+        _salsa_qr(x, 0, 1, 2, 3)
+        _salsa_qr(x, 5, 6, 7, 4)
+        _salsa_qr(x, 10, 11, 8, 9)
+        _salsa_qr(x, 15, 12, 13, 14)
+    o1 = x[1] + init[1]
+    o2 = x[2] + init[2]
+    o3 = x[3] + init[3]
+    o4 = x[4] + init[4]
+    return u128._stack_last([o4, o3, o2, o1])
+
+
+def _chacha_qr(x, a, b, c, d):
+    x[a] = x[a] + x[b]
+    x[d] = _rotl(x[d] ^ x[a], 16)
+    x[c] = x[c] + x[d]
+    x[b] = _rotl(x[b] ^ x[c], 12)
+    x[a] = x[a] + x[b]
+    x[d] = _rotl(x[d] ^ x[a], 8)
+    x[c] = x[c] + x[d]
+    x[b] = _rotl(x[b] ^ x[c], 7)
+
+
+def prf_chacha20_12_v(seeds, pos: int):
+    """12-round ChaCha core; key = seed words MSW-first in state 4..7."""
+    zero = seeds[..., 0] - seeds[..., 0]
+    x = [zero + _const(seeds, 0)] * 16
+    for i in range(4):
+        x[i] = zero + _const(seeds, _SIGMA[i])
+    x[4] = seeds[..., 3]
+    x[5] = seeds[..., 2]
+    x[6] = seeds[..., 1]
+    x[7] = seeds[..., 0]
+    x[12] = zero + _const(seeds, (pos >> 32) & 0xFFFFFFFF)
+    x[13] = zero + _const(seeds, pos & 0xFFFFFFFF)
+    init = list(x)
+    for _ in range(6):
+        _chacha_qr(x, 0, 4, 8, 12)
+        _chacha_qr(x, 1, 5, 9, 13)
+        _chacha_qr(x, 2, 6, 10, 14)
+        _chacha_qr(x, 3, 7, 11, 15)
+        _chacha_qr(x, 0, 5, 10, 15)
+        _chacha_qr(x, 1, 6, 11, 12)
+        _chacha_qr(x, 2, 7, 8, 13)
+        _chacha_qr(x, 3, 4, 9, 14)
+    o4 = x[4] + init[4]
+    o5 = x[5] + init[5]
+    o6 = x[6] + init[6]
+    o7 = x[7] + init[7]
+    return u128._stack_last([o7, o6, o5, o4])
+
+
+# ---------------------------------------------------------------------------
+# AES-128, byte-gather variant (host / debug)
+# ---------------------------------------------------------------------------
+
+_SBOX_NP = np.array(SBOX, dtype=np.uint32)
+
+
+def _is_np(x):
+    return isinstance(x, np.ndarray)
+
+
+def _take(table_np, idx):
+    if _is_np(idx):
+        return table_np[idx]
+    import jax.numpy as jnp
+    return jnp.asarray(table_np)[idx]
+
+
+def _bytes_of_limbs(seeds):
+    """[..., 4]u32 -> [..., 16]u32 little-endian bytes."""
+    parts = []
+    for i in range(4):
+        w = seeds[..., i]
+        for s in (0, 8, 16, 24):
+            parts.append((w >> np.uint32(s)) & np.uint32(0xFF))
+    return u128._stack_last(parts)
+
+
+def _limbs_of_bytes(b):
+    """[..., 16]u32 bytes (LE) -> [..., 4]u32 limbs."""
+    limbs = []
+    for i in range(4):
+        w = (b[..., 4 * i]
+             | (b[..., 4 * i + 1] << np.uint32(8))
+             | (b[..., 4 * i + 2] << np.uint32(16))
+             | (b[..., 4 * i + 3] << np.uint32(24)))
+        limbs.append(w)
+    return u128._stack_last(limbs)
+
+
+def _xtime_v(b):
+    """GF(2^8) doubling on uint32 byte lanes."""
+    d = (b << np.uint32(1)) ^ (((b >> np.uint32(7)) & np.uint32(1))
+                               * np.uint32(0x1B))
+    return d & np.uint32(0xFF)
+
+
+def prf_aes128_v(seeds, pos: int):
+    """FIPS-197 AES-128 per seed: key = seed LE bytes, pt = pos LE bytes.
+
+    Gather (S-box lookup) variant.  Per-call key expansion is fused with
+    encryption round-by-round so only one round key is live at a time — the
+    optimization the reference left as a TODO (``dpf.py:32-33``).
+    """
+    kb = _bytes_of_limbs(seeds)  # [..., 16] key bytes
+    rk = [kb[..., i] for i in range(16)]
+    zero = seeds[..., 0] - seeds[..., 0]
+    pt = (pos & ((1 << 128) - 1)).to_bytes(16, "little")
+    st = [zero + np.uint32(ptb) for ptb in pt]
+
+    def sub(v):
+        return _take(_SBOX_NP, v)
+
+    rcon = 1
+    # round 0 key addition
+    st = [st[i] ^ rk[i] for i in range(16)]
+    for rnd in range(1, 11):
+        # SubBytes
+        st = [sub(v) for v in st]
+        # ShiftRows: byte r of column c comes from column (c+r)%4
+        st = [st[(4 * ((i // 4 + i % 4) % 4)) + i % 4] for i in range(16)]
+        # MixColumns (skipped in final round)
+        if rnd < 10:
+            ns = list(st)
+            for c in range(4):
+                a = st[4 * c:4 * c + 4]
+                t = a[0] ^ a[1] ^ a[2] ^ a[3]
+                ns[4 * c + 0] = a[0] ^ t ^ _xtime_v(a[0] ^ a[1])
+                ns[4 * c + 1] = a[1] ^ t ^ _xtime_v(a[1] ^ a[2])
+                ns[4 * c + 2] = a[2] ^ t ^ _xtime_v(a[2] ^ a[3])
+                ns[4 * c + 3] = a[3] ^ t ^ _xtime_v(a[3] ^ a[0])
+            st = ns
+        # expand next round key in place (fused key schedule)
+        t = [sub(rk[13]), sub(rk[14]), sub(rk[15]), sub(rk[12])]
+        t[0] = t[0] ^ np.uint32(rcon)
+        rcon = ((rcon << 1) ^ (0x11B if rcon & 0x80 else 0)) & 0xFF
+        nk = list(rk)
+        for i in range(4):
+            nk[i] = rk[i] ^ t[i]
+        for i in range(4, 16):
+            nk[i] = nk[i - 4] ^ rk[i]
+        rk = nk
+        # AddRoundKey
+        st = [st[i] ^ rk[i] for i in range(16)]
+    return _limbs_of_bytes(u128._stack_last(st))
+
+
+# ---------------------------------------------------------------------------
+# JAX rolled-loop variants.
+#
+# The unrolled round loops above are fine for NumPy, but traced under jit
+# they emit the full round chain per tree level (12 rounds x ~50 ops x
+# log2(N) levels), which explodes XLA compile time.  These variants put the
+# round loop in lax.fori_loop so each PRF body is compiled once per level:
+# identical arithmetic, ~10x smaller HLO.
+# ---------------------------------------------------------------------------
+
+def _salsa_state(seeds, pos: int):
+    import jax.numpy as jnp
+    zero = seeds[..., 0] - seeds[..., 0]
+    x = [zero] * 16
+    x[0] = zero + np.uint32(_SIGMA[0])
+    x[5] = zero + np.uint32(_SIGMA[1])
+    x[10] = zero + np.uint32(_SIGMA[2])
+    x[15] = zero + np.uint32(_SIGMA[3])
+    x[1], x[2], x[3], x[4] = (seeds[..., 3], seeds[..., 2], seeds[..., 1],
+                              seeds[..., 0])
+    x[8] = zero + np.uint32((pos >> 32) & 0xFFFFFFFF)
+    x[9] = zero + np.uint32(pos & 0xFFFFFFFF)
+    return jnp.stack(x)
+
+
+def prf_salsa20_12_jax(seeds, pos: int):
+    import jax
+    import jax.numpy as jnp
+    init = _salsa_state(seeds, pos)
+
+    def double_round(_, s):
+        x = [s[i] for i in range(16)]
+        for (a, b, c, d) in ((0, 4, 8, 12), (5, 9, 13, 1), (10, 14, 2, 6),
+                             (15, 3, 7, 11), (0, 1, 2, 3), (5, 6, 7, 4),
+                             (10, 11, 8, 9), (15, 12, 13, 14)):
+            x[b] = x[b] ^ _rotl(x[a] + x[d], 7)
+            x[c] = x[c] ^ _rotl(x[b] + x[a], 9)
+            x[d] = x[d] ^ _rotl(x[c] + x[b], 13)
+            x[a] = x[a] ^ _rotl(x[d] + x[c], 18)
+        return jnp.stack(x)
+
+    x = jax.lax.fori_loop(0, 6, double_round, init)
+    out = x + init
+    return u128._stack_last([out[4], out[3], out[2], out[1]])
+
+
+def _chacha_state(seeds, pos: int):
+    import jax.numpy as jnp
+    zero = seeds[..., 0] - seeds[..., 0]
+    x = [zero + np.uint32(_SIGMA[i]) for i in range(4)] + [zero] * 12
+    x[4], x[5], x[6], x[7] = (seeds[..., 3], seeds[..., 2], seeds[..., 1],
+                              seeds[..., 0])
+    x[12] = zero + np.uint32((pos >> 32) & 0xFFFFFFFF)
+    x[13] = zero + np.uint32(pos & 0xFFFFFFFF)
+    return jnp.stack(x)
+
+
+def prf_chacha20_12_jax(seeds, pos: int):
+    import jax
+    import jax.numpy as jnp
+    init = _chacha_state(seeds, pos)
+
+    def double_round(_, s):
+        x = [s[i] for i in range(16)]
+        for (a, b, c, d) in ((0, 4, 8, 12), (1, 5, 9, 13), (2, 6, 10, 14),
+                             (3, 7, 11, 15), (0, 5, 10, 15), (1, 6, 11, 12),
+                             (2, 7, 8, 13), (3, 4, 9, 14)):
+            x[a] = x[a] + x[b]
+            x[d] = _rotl(x[d] ^ x[a], 16)
+            x[c] = x[c] + x[d]
+            x[b] = _rotl(x[b] ^ x[c], 12)
+            x[a] = x[a] + x[b]
+            x[d] = _rotl(x[d] ^ x[a], 8)
+            x[c] = x[c] + x[d]
+            x[b] = _rotl(x[b] ^ x[c], 7)
+        return jnp.stack(x)
+
+    x = jax.lax.fori_loop(0, 6, double_round, init)
+    out = x + init
+    return u128._stack_last([out[7], out[6], out[5], out[4]])
+
+
+_RCON = np.array([0, 1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36],
+                 dtype=np.uint32)
+
+# ShiftRows as a static permutation of flat byte index i = 4*col + row:
+# new[4c + r] = old[4*((c + r) % 4) + r]
+_SHIFT_ROWS = np.array([(4 * ((i // 4 + i % 4) % 4)) + i % 4
+                        for i in range(16)])
+
+
+def prf_aes128_jax(seeds, pos: int):
+    """AES-128 with the 9 uniform middle rounds in a fori_loop."""
+    import jax
+    import jax.numpy as jnp
+    sbox = jnp.asarray(_SBOX_NP)
+
+    kb = _bytes_of_limbs(seeds)
+    rk = jnp.stack([kb[..., i] for i in range(16)])  # [16, ...]
+    zero = seeds[..., 0] - seeds[..., 0]
+    pt = (pos & ((1 << 128) - 1)).to_bytes(16, "little")
+    st = jnp.stack([zero + np.uint32(b) for b in pt])
+
+    rcon = jnp.asarray(_RCON)
+
+    def next_round_key(rk, rnd):
+        t = [sbox[rk[13]] ^ rcon[rnd], sbox[rk[14]], sbox[rk[15]],
+             sbox[rk[12]]]
+        w = [rk[i] ^ t[i] for i in range(4)]
+        for i in range(4, 16):
+            w.append(w[i - 4] ^ rk[i])
+        return jnp.stack(w)
+
+    def mix_columns(x):
+        ns = []
+        for c in range(4):
+            a = [x[4 * c + r] for r in range(4)]
+            t = a[0] ^ a[1] ^ a[2] ^ a[3]
+            ns.append(a[0] ^ t ^ _xtime_v(a[0] ^ a[1]))
+            ns.append(a[1] ^ t ^ _xtime_v(a[1] ^ a[2]))
+            ns.append(a[2] ^ t ^ _xtime_v(a[2] ^ a[3]))
+            ns.append(a[3] ^ t ^ _xtime_v(a[3] ^ a[0]))
+        return jnp.stack(ns)
+
+    st = st ^ rk  # round 0
+
+    def round_body(rnd, carry):
+        st, rk = carry
+        st = sbox[st]                 # SubBytes, one gather
+        st = st[_SHIFT_ROWS]          # ShiftRows, static row permute
+        st = mix_columns(st)
+        rk = next_round_key(rk, rnd)
+        return (st ^ rk, rk)
+
+    st, rk = jax.lax.fori_loop(1, 10, round_body, (st, rk))
+    # final round: no MixColumns
+    st = sbox[st][_SHIFT_ROWS]
+    rk = next_round_key(rk, 10)
+    st = st ^ rk
+    return _limbs_of_bytes(u128._stack_last([st[i] for i in range(16)]))
+
+
+# ---------------------------------------------------------------------------
+# Dispatch
+# ---------------------------------------------------------------------------
+
+PRF_V_NUMPY = {
+    PRF_DUMMY: prf_dummy_v,
+    PRF_SALSA20: prf_salsa20_12_v,
+    PRF_CHACHA20: prf_chacha20_12_v,
+    PRF_AES128: prf_aes128_v,
+}
+
+PRF_V_JAX = {
+    PRF_DUMMY: prf_dummy_v,  # small graph already
+    PRF_SALSA20: prf_salsa20_12_jax,
+    PRF_CHACHA20: prf_chacha20_12_jax,
+    PRF_AES128: prf_aes128_jax,
+}
+
+
+def prf_v(method: int, seeds, pos: int):
+    """Vectorized PRF dispatch; `method` and `pos` are static."""
+    if isinstance(seeds, np.ndarray):
+        return PRF_V_NUMPY[method](seeds, pos)
+    return PRF_V_JAX[method](seeds, pos)

@@ -1,0 +1,203 @@
+"""128-bit unsigned arithmetic as 4 x uint32 little-endian limbs.
+
+TPU has no native 64/128-bit integer types (and no carry flags), so all
+Z_{2^128} arithmetic in this framework is expressed over arrays whose trailing
+axis holds 4 uint32 limbs, limb 0 least-significant.  Carries are recovered
+with unsigned comparisons and 32x32->64 products are assembled from 16-bit
+halves -- both of which lower to plain VPU int32 ops under XLA.
+
+Counterpart of the reference's PTX uint128 helpers (``dpf_gpu/utils.h:45-83``),
+re-derived for a carry-less SIMD ISA rather than translated.
+
+Every function here is *backend generic*: it only uses operators and methods
+shared by ``numpy`` and ``jax.numpy`` arrays, so the same code runs as the
+NumPy host reference and inside jitted TPU programs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+U32_MASK = 0xFFFFFFFF
+NLIMBS = 4
+
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (Python int <-> limb arrays)
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int (mod 2^128) -> [4] uint32 little-endian limb array."""
+    x &= (1 << 128) - 1
+    return np.array([(x >> (32 * i)) & U32_MASK for i in range(NLIMBS)],
+                    dtype=np.uint32)
+
+
+def limbs_to_int(limbs) -> int:
+    """[..., 4] uint32 limb array -> Python int (only for scalar [4] input)."""
+    arr = np.asarray(limbs, dtype=np.uint32).reshape(-1)
+    assert arr.shape == (NLIMBS,)
+    return sum(int(arr[i]) << (32 * i) for i in range(NLIMBS))
+
+
+def ints_to_limbs(xs) -> np.ndarray:
+    """Iterable of Python ints -> [len, 4] uint32 limb array."""
+    return np.stack([int_to_limbs(int(x)) for x in xs])
+
+
+def limbs_to_ints(limbs) -> list:
+    """[..., 4] limb array -> flat list of Python ints."""
+    arr = np.asarray(limbs, dtype=np.uint32).reshape(-1, NLIMBS)
+    return [sum(int(r[i]) << (32 * i) for i in range(NLIMBS)) for r in arr]
+
+
+# ---------------------------------------------------------------------------
+# Backend-generic limb arithmetic.  All take/return [..., 4] uint32 arrays.
+# ---------------------------------------------------------------------------
+
+def _u32(x):
+    return x.astype(np.uint32) if hasattr(x, "astype") else np.uint32(x)
+
+
+def add128(a, b):
+    """(a + b) mod 2^128, elementwise over leading axes.
+
+    Carry-out of ``a_i + b_i + c_in`` is recovered with two unsigned
+    comparisons: the first add wraps iff ``s < a_i``; adding the carry-in can
+    wrap only when the first add did not (s <= 2^32-2), so the two conditions
+    are disjoint and OR-combine.
+    """
+    out = []
+    carry = None
+    for i in range(NLIMBS):
+        ai = a[..., i]
+        s = ai + b[..., i]
+        c1 = _u32(s < ai)
+        if carry is not None:
+            s2 = s + carry
+            c2 = _u32(s2 < s)
+            s = s2
+            carry = c1 | c2
+        else:
+            carry = c1
+        out.append(s)
+    return _stack_last(out)
+
+
+def sub128(a, b):
+    """(a - b) mod 2^128."""
+    out = []
+    borrow = None
+    for i in range(NLIMBS):
+        ai = a[..., i]
+        d = ai - b[..., i]
+        b1 = _u32(ai < b[..., i])
+        if borrow is not None:
+            d2 = d - borrow
+            b2 = _u32(d < borrow)
+            d = d2
+            borrow = b1 | b2
+        else:
+            borrow = b1
+        out.append(d)
+    return _stack_last(out)
+
+
+def neg128(a):
+    """(-a) mod 2^128."""
+    zero = a - a
+    return sub128(zero, a)
+
+
+def _mul32_parts(a, b):
+    """Full 32x32 -> (hi32, lo32) product from 16-bit halves (no u64)."""
+    mask16 = _u32(a - a) + np.uint32(0xFFFF)  # broadcast constant
+    al = a & mask16
+    ah = a >> 16
+    bl = b & mask16
+    bh = b >> 16
+    lo_lo = al * bl
+    mid1 = ah * bl
+    mid2 = al * bh
+    hi_hi = ah * bh
+    cross = (lo_lo >> 16) + (mid1 & mask16) + (mid2 & mask16)
+    hi = hi_hi + (mid1 >> 16) + (mid2 >> 16) + (cross >> 16)
+    lo = a * b  # native wrapping uint32 multiply
+    return hi, lo
+
+
+def mul128(a, b):
+    """(a * b) mod 2^128 — schoolbook over 32-bit limbs, low 128 bits kept."""
+    zero = a[..., 0] - a[..., 0]
+    r = [zero, zero, zero, zero]
+    for i in range(NLIMBS):
+        carry = zero
+        for j in range(NLIMBS - i):
+            k = i + j
+            hi, lo = _mul32_parts(a[..., i], b[..., j])
+            s = r[k] + lo
+            c1 = _u32(s < r[k])
+            s2 = s + carry
+            c2 = _u32(s2 < s)
+            r[k] = s2
+            # next-limb carry: hi + c1 + c2 cannot overflow uint32 — when
+            # hi is maximal (2^32 - 2, at a=b=0xFFFFFFFF) lo <= 1, which
+            # makes the two wrap conditions c1, c2 mutually exclusive
+            carry = hi + c1 + c2
+        # carry beyond limb 3 is discarded (mod 2^128)
+    return _stack_last(r)
+
+
+def mul128_small(a, c: int):
+    """(a * c) mod 2^128 for a compile-time small uint32 constant c."""
+    b_limb = np.uint32(c)
+    zero = a[..., 0] - a[..., 0]
+    r = []
+    carry = zero
+    for i in range(NLIMBS):
+        hi, lo = _mul32_parts(a[..., i], zero + b_limb)
+        s = lo + carry
+        c2 = _u32(s < lo)
+        r.append(s)
+        carry = hi + c2
+    return _stack_last(r)
+
+
+def _stack_last(parts):
+    """Stack a list of [...]-shaped arrays into [..., len(parts)]."""
+    first = parts[0]
+    if isinstance(first, np.ndarray) or np.isscalar(first):
+        return np.stack(parts, axis=-1)
+    import jax.numpy as jnp
+    return jnp.stack(parts, axis=-1)
+
+
+def lsb(a):
+    """Least-significant bit of each 128-bit value, as uint32 of shape [...]."""
+    return a[..., 0] & np.uint32(1)
+
+
+def low32(a):
+    """Value mod 2^32 (limb 0)."""
+    return a[..., 0]
+
+
+# ---------------------------------------------------------------------------
+# Bit reversal (host side; used once per eval_init to pre-permute the table)
+# ---------------------------------------------------------------------------
+
+def bit_reverse_indices(n: int) -> np.ndarray:
+    """Permutation p with p[i] = bit_reverse(i) over log2(n) bits.
+
+    Breadth-first GGM expansion emits leaf j at position bit_reverse(j)
+    (index bits are consumed LSB-first, reference ``dpf_base/dpf.h:362-377``);
+    permuting the table once at init makes the fused contraction use natural
+    row order (reference ``dpf_wrapper.cu:104-109``).
+    """
+    assert n > 0 and (n & (n - 1)) == 0
+    bits = n.bit_length() - 1
+    idx = np.arange(n, dtype=np.uint64)
+    rev = np.zeros_like(idx)
+    for b in range(bits):
+        rev |= ((idx >> b) & 1) << (bits - 1 - b)
+    return rev.astype(np.int64)

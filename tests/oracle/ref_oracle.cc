@@ -1,0 +1,78 @@
+// Differential-test oracle: links the *reference* implementation at
+// /root/reference (read-only) to verify that keys produced by this
+// framework's keygen are bit-exactly evaluable by the reference's
+// EvaluateFlat, and that the four PRFs agree.  Built and run only by
+// tests/test_reference_interop.py when the reference tree is present.
+//
+// Protocol (stdin -> stdout, all little-endian hex):
+//   line: "prf <method> <seed_hex> <pos_hex>"   -> prints PRF result hex
+//   line: "eval <method> <n_indices> <idx...> " followed by 524 int32
+//         (hex words, one line) -> prints low-32 eval results
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include "dpf_base/dpf.h"
+
+static uint128_t parse_u128(const std::string &hexs) {
+  uint128_t v = 0;
+  for (char c : hexs) {
+    v <<= 4;
+    if (c >= '0' && c <= '9') v |= (uint128_t)(c - '0');
+    else if (c >= 'a' && c <= 'f') v |= (uint128_t)(c - 'a' + 10);
+    else if (c >= 'A' && c <= 'F') v |= (uint128_t)(c - 'A' + 10);
+  }
+  return v;
+}
+
+static void print_u128(uint128_t v) {
+  char buf[33];
+  for (int i = 31; i >= 0; i--) {
+    buf[i] = "0123456789abcdef"[(int)(v & 0xF)];
+    v >>= 4;
+  }
+  buf[32] = 0;
+  std::cout << buf << "\n";
+}
+
+int main() {
+  std::string op;
+  while (std::cin >> op) {
+    if (op == "prf") {
+      int method;
+      std::string seed_hex, pos_hex;
+      std::cin >> method >> seed_hex >> pos_hex;
+      uint128_t r = PRF_SELECT(method)(parse_u128(seed_hex), parse_u128(pos_hex));
+      print_u128(r);
+    } else if (op == "eval") {
+      int method, n_idx;
+      std::cin >> method >> n_idx;
+      std::vector<int> idx(n_idx);
+      for (auto &i : idx) std::cin >> i;
+      // 524 int32 words as hex
+      std::vector<uint32_t> words(524);
+      for (auto &w : words) {
+        std::string h;
+        std::cin >> h;
+        w = (uint32_t)strtoul(h.c_str(), nullptr, 16);
+      }
+      SeedsCodewordsFlat k;
+      const uint128_t *slots = (const uint128_t *)words.data();
+      k.depth = (int)slots[0];
+      memcpy(k.cw_1, &slots[1], sizeof(uint128_t) * 64);
+      memcpy(k.cw_2, &slots[65], sizeof(uint128_t) * 64);
+      k.last_keys[0] = slots[129];
+      for (int i : idx) {
+        uint128_t r = EvaluateFlat(&k, i, method);
+        std::cout << (uint32_t)r << "\n";
+      }
+    } else {
+      return 1;
+    }
+  }
+  return 0;
+}

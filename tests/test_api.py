@@ -1,0 +1,147 @@
+"""End-to-end DPF API tests — ports of the reference's Python self-tests
+(``dpf.py:139-320``): one-hot CPU check, CPU table check, accelerated-path
+check, no-pad shapes, randomized sweep."""
+
+import random
+
+import numpy as np
+import pytest
+
+from dpf_tpu import DPF
+
+random.seed(20260728)
+
+
+def _gen_batch(dpf, n, batch):
+    k1s, k2s, idxs = [], [], []
+    for _ in range(batch):
+        idx = random.randint(0, n - 1)
+        idxs.append(idx)
+        k1, k2 = dpf.gen(idx, n)
+        k1s.append(k1)
+        k2s.append(k2)
+    return k1s, k2s, idxs
+
+
+def _structured_table(n, e=16):
+    return (np.arange(n)[:, None] * e + np.arange(e)[None, :]).astype(np.int32)
+
+
+def test_cpu_dpf_one_hot():
+    n, k = 1024, 42
+    dpf = DPF(prf=DPF.PRF_SALSA20)
+    k1, k2 = dpf.gen(k, n)
+    v1 = np.asarray(dpf.eval_cpu([k1], one_hot_only=True))
+    v2 = np.asarray(dpf.eval_cpu([k2], one_hot_only=True))
+    rec = v1 - v2
+    gt = np.zeros_like(rec)
+    gt[:, k] = 1
+    assert (rec == gt).all()
+
+
+def test_cpu_dpf():
+    n = 1024
+    dpf = DPF(prf=DPF.PRF_SALSA20)
+    k1s, k2s, idxs = _gen_batch(dpf, n, 16)
+    table = _structured_table(n)
+    dpf.eval_init(table)
+    rec = np.asarray(dpf.eval_cpu(k1s)) - np.asarray(dpf.eval_cpu(k2s))
+    assert (rec == table[idxs]).all()
+
+
+@pytest.mark.parametrize("prf", [DPF.PRF_DUMMY, DPF.PRF_SALSA20,
+                                 DPF.PRF_CHACHA20, DPF.PRF_AES128])
+def test_tpu_dpf(prf):
+    n = 2048
+    dpf = DPF(prf=prf)
+    k1s, k2s, idxs = _gen_batch(dpf, n, 8)
+    table = _structured_table(n)
+    dpf.eval_init(table)
+    rec = np.asarray(dpf.eval_tpu(k1s)) - np.asarray(dpf.eval_tpu(k2s))
+    assert (rec == table[idxs]).all()
+
+
+def test_tpu_dpf_torch_tables():
+    torch = pytest.importorskip("torch")
+    n = 256
+    dpf = DPF(prf=DPF.PRF_CHACHA20)
+    k1s, k2s, idxs = _gen_batch(dpf, n, 4)
+    table = torch.randint(2 ** 31, (n, 16)).int()
+    dpf.eval_init(table)
+    a = dpf.eval_gpu(k1s)  # reference alias
+    b = dpf.eval_gpu(k2s)
+    rec = (a - b).numpy()
+    assert (rec == table[idxs, :].numpy()).all()
+
+
+def test_tpu_dpf_nopad():
+    """Non-power-of-two batch and entry_size < 16 (reference nopad test)."""
+    n, batch, entrysize = 512, 13, 13
+    dpf = DPF(prf=DPF.PRF_SALSA20)
+    k1s, k2s, idxs = _gen_batch(dpf, n, batch)
+    table = np.random.randint(-2 ** 31, 2 ** 31, (n, entrysize),
+                              dtype=np.int64).astype(np.int32)
+    dpf.eval_init(table)
+    a = np.asarray(dpf.eval_tpu(k1s))
+    b = np.asarray(dpf.eval_tpu(k2s))
+    assert a.shape == (batch, entrysize)
+    assert ((a - b) == table[idxs]).all()
+
+
+def test_tpu_dpf_sweep():
+    """Randomized shape sweep (reference ``test_gpu_dpf_sweep``, reduced)."""
+    for n in [128, 256, 1024]:
+        batch = random.randint(1, 9)
+        entrysize = random.randint(1, 15)
+        dpf = DPF(prf=DPF.PRF_DUMMY)
+        k1s, k2s, idxs = _gen_batch(dpf, n, batch)
+        table = np.random.randint(0, 2 ** 31, (n, entrysize),
+                                  dtype=np.int64).astype(np.int32)
+        dpf.eval_init(table)
+        rec = np.asarray(dpf.eval_tpu(k1s)) - np.asarray(dpf.eval_tpu(k2s))
+        assert (rec == table[idxs]).all(), n
+
+
+def test_cpu_tpu_agree_per_server():
+    """Each server's raw share must agree between host and device paths."""
+    n = 512
+    dpf = DPF(prf=DPF.PRF_AES128)
+    k1s, k2s, _ = _gen_batch(dpf, n, 3)
+    table = _structured_table(n, 5)
+    dpf.eval_init(table)
+    assert (np.asarray(dpf.eval_cpu(k1s)) ==
+            np.asarray(dpf.eval_tpu(k1s))).all()
+    assert (np.asarray(dpf.eval_cpu(k2s)) ==
+            np.asarray(dpf.eval_tpu(k2s))).all()
+
+
+def test_errors():
+    dpf = DPF()
+    with pytest.raises(ValueError):
+        dpf.gen(5, 100)          # not power of two
+    with pytest.raises(ValueError):
+        dpf.gen(8, 8)            # k >= n
+    with pytest.raises(RuntimeError):
+        dpf.eval_tpu([np.zeros(524, np.int32)])  # init missing
+    with pytest.raises(ValueError):
+        dpf.eval_init(np.zeros((64, 4), np.int32))   # too few entries
+    with pytest.raises(ValueError):
+        dpf.eval_init(np.zeros((256, 40), np.int32))  # entry too wide
+    dpf.eval_init(np.zeros((128, 4), np.int32))
+    k1, _ = dpf.gen(1, 256)  # wrong n for this table
+    with pytest.raises(ValueError):
+        dpf.eval_tpu([k1])
+    assert "entries=128" in repr(dpf)
+    dpf.eval_free()
+    assert "_uninitialized_" in repr(dpf)
+
+
+def test_wide_entries_non_strict():
+    """strict=False lifts the 16-word entry cap (reference TODO dpf.py:16)."""
+    n, e = 128, 24
+    dpf = DPF(prf=DPF.PRF_DUMMY, strict=False)
+    k1s, k2s, idxs = _gen_batch(dpf, n, 2)
+    table = np.random.randint(0, 2 ** 31, (n, e), np.int64).astype(np.int32)
+    dpf.eval_init(table)
+    rec = np.asarray(dpf.eval_tpu(k1s)) - np.asarray(dpf.eval_tpu(k2s))
+    assert (rec == table[idxs]).all()

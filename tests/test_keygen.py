@@ -1,0 +1,99 @@
+"""Keygen + flat-eval exactness tests (role of the reference's
+``test_log_n_method`` / ``test_flat_codewords``, ``dpf_base/dpf.h:483-578``,
+run exhaustively at small N for both servers and all PRFs)."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import evalref, keygen, prf_ref
+
+MASK = prf_ref.MASK128
+
+
+@pytest.mark.parametrize("method", [0, 1, 2, 3])
+@pytest.mark.parametrize("n", [2, 4, 8, 64, 256])
+def test_exhaustive_share_recovery(method, n):
+    if method == 3 and n > 64:
+        pytest.skip("scalar-Python AES too slow at this n; the same space "
+                    "is covered vectorized in test_bfs_expansion/test_api")
+    for alpha in {0, 1, n // 2, n - 1}:
+        k0, k1 = keygen.generate_keys(alpha, n, b"t%d" % alpha, method)
+        for i in range(n):
+            a = keygen.evaluate_flat(k0, i, method)
+            b = keygen.evaluate_flat(k1, i, method)
+            assert (a - b) & MASK == (1 if i == alpha else 0)
+
+
+def test_beta_values():
+    n, alpha, beta = 64, 17, 210
+    k0, k1 = keygen.generate_keys(alpha, n, b"beta", 0, beta=beta)
+    for i in range(n):
+        a = keygen.evaluate_flat(k0, i, 0)
+        b = keygen.evaluate_flat(k1, i, 0)
+        assert (a - b) & MASK == (beta if i == alpha else 0)
+
+
+def test_deterministic_given_seed():
+    a = keygen.generate_keys(5, 256, b"same-seed", 1)
+    b = keygen.generate_keys(5, 256, b"same-seed", 1)
+    assert (a[0].serialize() == b[0].serialize()).all()
+    c = keygen.generate_keys(5, 256, b"other-seed", 1)
+    assert not (a[0].serialize() == c[0].serialize()).all()
+
+
+def test_serialize_roundtrip():
+    k0, _ = keygen.generate_keys(100, 16384, b"rt", 2)
+    s = k0.serialize()
+    assert s.shape == (524,) and s.dtype == np.int32 and s.nbytes == 2096
+    k = keygen.deserialize_key(s)
+    assert k.depth == k0.depth == 14
+    assert k.last_key == k0.last_key
+    assert k.n == 16384
+    assert (k.cw1 == k0.cw1).all() and (k.cw2 == k0.cw2).all()
+
+
+def test_max_table_size_key_roundtrip():
+    """n = 2^32 (advertised max) must survive serialization: the value
+    spills into limb 1 of wire slot 130."""
+    alpha = 123456789
+    k0, k1 = keygen.generate_keys(alpha, 1 << 32, b"max", 0)
+    k = keygen.deserialize_key(k0.serialize())
+    assert k.n == 1 << 32 and k.depth == 32
+    a = keygen.evaluate_flat(k, alpha, 0)
+    b = keygen.evaluate_flat(keygen.deserialize_key(k1.serialize()), alpha, 0)
+    assert (a - b) & MASK == 1
+
+
+def test_deserialize_rejects_bad_shape():
+    with pytest.raises(ValueError):
+        keygen.deserialize_key(np.zeros(100, np.int32))
+
+
+@pytest.mark.parametrize("method", [0, 1, 2, 3])
+def test_bfs_expansion_matches_flat_eval(method):
+    """NumPy breadth-first expansion == scalar EvaluateFlat at every index."""
+    n, alpha = 128, 77
+    k0, k1 = keygen.generate_keys(alpha, n, b"bfs", method)
+    for k in (k0, k1):
+        hot = evalref.eval_one_hot_i32(k, method)
+        assert hot.shape == (n,)
+        for i in range(0, n, 7):
+            want = keygen.evaluate_flat(k, i, method) & 0xFFFFFFFF
+            assert hot.view(np.uint32)[i] == want
+
+
+def test_one_hot_difference():
+    n, alpha = 512, 300
+    k0, k1 = keygen.generate_keys(alpha, n, b"hot", 1)
+    d = (evalref.eval_one_hot_i32(k0, 1).view(np.uint32)
+         - evalref.eval_one_hot_i32(k1, 1).view(np.uint32))
+    gt = np.zeros(n, np.uint32)
+    gt[alpha] = 1
+    assert (d == gt).all()
+
+
+def test_keygen_validation():
+    with pytest.raises(ValueError):
+        keygen.generate_keys(0, 100, b"x", 0)  # not a power of two
+    with pytest.raises(ValueError):
+        keygen.generate_keys(8, 8, b"x", 0)    # alpha out of range

@@ -1,0 +1,69 @@
+"""PRF known-answer and differential tests.
+
+The reference has no PRF KATs (it relies on CPU/GPU implementations
+"matching exactly by construction", ``dpf_base/dpf.h:69``); SURVEY.md §4
+calls for adding them.  Cross-checks vs the C reference live in
+test_reference_interop.py.
+"""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import prf, prf_ref, u128
+
+
+def test_aes_fips197_kat():
+    key = bytes(range(16))
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    assert prf_ref._aes128_encrypt_block(key, pt).hex() == \
+        "69c4e0d86a7b0430d8cdb78070b4c55a"
+
+
+def test_aes_sbox():
+    assert prf_ref.SBOX[0x00] == 0x63
+    assert prf_ref.SBOX[0x01] == 0x7C
+    assert prf_ref.SBOX[0x53] == 0xED
+    assert prf_ref.SBOX[0xFF] == 0x16
+    assert sorted(prf_ref.SBOX) == list(range(256))  # bijective
+
+
+def test_dummy_semantics():
+    # seed * (pos+4242) + (pos+4242) mod 2^128
+    s = 0xDEADBEEF_00000001_FFFFFFFF_12345678
+    assert prf_ref.prf_dummy(s, 1) == (s * 4243 + 4243) & prf_ref.MASK128
+
+
+@pytest.fixture(scope="module")
+def seeds():
+    rng = np.random.default_rng(42)
+    ints = [int.from_bytes(rng.bytes(16), "little") for _ in range(33)]
+    ints += [0, 1, (1 << 128) - 1]
+    return ints, u128.ints_to_limbs(ints)
+
+
+@pytest.mark.parametrize("method", [0, 1, 2, 3])
+@pytest.mark.parametrize("pos", [0, 1])
+def test_vectorized_matches_scalar_numpy(seeds, method, pos):
+    ints, limbs = seeds
+    got = u128.limbs_to_ints(prf.prf_v(method, limbs, pos))
+    assert got == [prf_ref.prf(method, s, pos) for s in ints]
+
+
+@pytest.mark.parametrize("method", [0, 1, 2, 3])
+@pytest.mark.parametrize("pos", [0, 1])
+def test_vectorized_matches_scalar_jax(seeds, method, pos):
+    import jax
+    import jax.numpy as jnp
+    ints, limbs = seeds
+    fn = jax.jit(lambda s: prf.prf_v(method, s, pos))
+    got = u128.limbs_to_ints(np.asarray(fn(jnp.asarray(limbs))))
+    assert got == [prf_ref.prf(method, s, pos) for s in ints]
+
+
+def test_vectorized_2d_shapes(seeds):
+    """PRFs must accept arbitrary leading axes ([B, w, 4] in the tree walk)."""
+    ints, limbs = seeds
+    grid = np.broadcast_to(limbs[:32].reshape(4, 8, 4), (4, 8, 4)).copy()
+    out = prf.prf_v(prf_ref.PRF_SALSA20, grid, 1)
+    flat = prf.prf_v(prf_ref.PRF_SALSA20, limbs[:32], 1)
+    assert (out.reshape(-1, 4) == flat).all()

@@ -1,0 +1,82 @@
+"""uint128 limb arithmetic tests (role of the reference's
+``dpf_gpu/tests/test_128_bit.cu``, asserted against Python ints)."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import u128
+
+MASK = (1 << 128) - 1
+
+
+@pytest.fixture(scope="module")
+def pairs():
+    rng = np.random.default_rng(7)
+    xs = [int.from_bytes(rng.bytes(16), "little") for _ in range(64)]
+    ys = [int.from_bytes(rng.bytes(16), "little") for _ in range(64)]
+    # edge cases
+    xs += [0, 1, MASK, MASK - 1, 1 << 64, (1 << 64) - 1]
+    ys += [0, MASK, 1, MASK, MASK, (1 << 64) + 1]
+    return xs, ys
+
+
+def test_conversion_roundtrip(pairs):
+    xs, _ = pairs
+    assert u128.limbs_to_ints(u128.ints_to_limbs(xs)) == [x & MASK for x in xs]
+
+
+def test_add128(pairs):
+    xs, ys = pairs
+    a, b = u128.ints_to_limbs(xs), u128.ints_to_limbs(ys)
+    got = u128.limbs_to_ints(u128.add128(a, b))
+    assert got == [(x + y) & MASK for x, y in zip(xs, ys)]
+
+
+def test_sub128(pairs):
+    xs, ys = pairs
+    a, b = u128.ints_to_limbs(xs), u128.ints_to_limbs(ys)
+    got = u128.limbs_to_ints(u128.sub128(a, b))
+    assert got == [(x - y) & MASK for x, y in zip(xs, ys)]
+
+
+def test_mul128(pairs):
+    xs, ys = pairs
+    a, b = u128.ints_to_limbs(xs), u128.ints_to_limbs(ys)
+    got = u128.limbs_to_ints(u128.mul128(a, b))
+    assert got == [(x * y) & MASK for x, y in zip(xs, ys)]
+
+
+def test_mul128_chained(pairs):
+    """Chained multiplies (mirrors the reference's chained-mul test)."""
+    xs, ys = pairs
+    acc_int = 1
+    acc = u128.ints_to_limbs([1])
+    for x in xs[:8]:
+        acc = u128.mul128(acc, u128.ints_to_limbs([x]))
+        acc_int = (acc_int * x) & MASK
+    assert u128.limbs_to_ints(acc) == [acc_int]
+
+
+def test_mul128_small(pairs):
+    xs, _ = pairs
+    a = u128.ints_to_limbs(xs)
+    got = u128.limbs_to_ints(u128.mul128_small(a, 4243))
+    assert got == [(x * 4243) & MASK for x in xs]
+
+
+def test_add128_jax(pairs):
+    import jax.numpy as jnp
+    xs, ys = pairs
+    a = jnp.asarray(u128.ints_to_limbs(xs))
+    b = jnp.asarray(u128.ints_to_limbs(ys))
+    got = u128.limbs_to_ints(np.asarray(u128.add128(a, b)))
+    assert got == [(x + y) & MASK for x, y in zip(xs, ys)]
+    got = u128.limbs_to_ints(np.asarray(u128.mul128(a, b)))
+    assert got == [(x * y) & MASK for x, y in zip(xs, ys)]
+
+
+def test_bit_reverse():
+    p = u128.bit_reverse_indices(8)
+    assert list(p) == [0, 4, 2, 6, 1, 5, 3, 7]
+    p = u128.bit_reverse_indices(1024)
+    assert (p[p] == np.arange(1024)).all()  # involution
