@@ -1,0 +1,177 @@
+"""Multi-chip DPF evaluation: table row-sharding + batch sharding on a mesh.
+
+The reference has no multi-GPU path at all (SURVEY.md §2.4); this module is
+where the TPU build goes beyond it.  Two orthogonal parallel axes map the
+workload onto a ``jax.sharding.Mesh``:
+
+* **"table" axis (the TP analogue)** — the bit-reverse-permuted table is
+  row-sharded; each chip owns a contiguous range of BFS leaf positions,
+  i.e. a set of whole GGM frontier subtrees.  Every chip replicates the
+  cheap phase-1 expansion (root -> frontier, O(B*F)), expands only its own
+  subtrees, contracts against its local table rows, and the partial int32
+  outputs are summed with ``psum`` over ICI.  Valid because additive secret
+  shares commute with partial dot products.
+* **"batch" axis (the DP analogue)** — independent DPF keys are embarrassingly
+  parallel; the key batch is sharded and outputs concatenated.
+
+Keys are ~2 KB each and broadcast over the mesh; output is [B, E] int32 —
+both negligible next to the O(N) expansion, so scaling is linear in chips
+until N/n_table_shards stops covering a chip.
+
+Multi-host runs use the same code: construct the mesh from
+``jax.distributed``-initialized global devices and lay the "table" axis on
+the ICI-adjacent dimension so psum rides ICI, not DCN.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..core import expand, u128
+from ..core.expand import _level_step  # shared level recurrence
+
+
+def make_mesh(n_table: int | None = None, n_batch: int = 1,
+              devices=None) -> Mesh:
+    """Build a ("batch", "table") mesh over the available devices."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if n_table is None:
+        n_table = devices.size // n_batch
+    assert n_table * n_batch == devices.size, \
+        "mesh axes (%d x %d) must cover %d devices" % (
+            n_batch, n_table, devices.size)
+    return Mesh(devices.reshape(n_batch, n_table), ("batch", "table"))
+
+
+def shard_table(table_i32: np.ndarray, mesh: Mesh):
+    """Permute (bit-reversal) and row-shard a table over the "table" axis."""
+    perm = expand.permute_table(np.asarray(table_i32, dtype=np.int32))
+    sharding = NamedSharding(mesh, P("table", None))
+    return jax.device_put(jnp.asarray(perm), sharding)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("depth", "prf_method", "chunk_leaves",
+                                    "mesh"))
+def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
+                 chunk_leaves: int, mesh: Mesh):
+    """Mesh-parallel fused DPF evaluation.
+
+    Inputs as in ``expand.expand_and_contract``; ``table_perm`` must be
+    row-sharded with ``shard_table``.  Returns [B, E] int32 shares,
+    replicated over the "table" axis and sharded over "batch".
+    """
+    n_shards = mesh.shape["table"]
+    n = table_perm.shape[0]
+    shard_rows = n // n_shards
+    assert shard_rows * n_shards == n
+
+    def per_shard(cw1, cw2, last, tbl_shard):
+        # tbl_shard: [n/shards, E] — this chip's BFS leaf range
+        shard_ix = jax.lax.axis_index("table")
+        out = _eval_leaf_range(cw1, cw2, last, tbl_shard,
+                               shard_ix * shard_rows,
+                               depth=depth, prf_method=prf_method,
+                               chunk_leaves=min(chunk_leaves, shard_rows),
+                               n_total=n)
+        return jax.lax.psum(out, "table")
+
+    fn = jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P("batch"), P("batch"), P("batch"), P("table", None)),
+        out_specs=P("batch", None))
+    return fn(cw1, cw2, last, table_perm)
+
+
+def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
+                     prf_method: int, chunk_leaves: int, n_total: int):
+    """Expand only BFS leaves [row0, row0 + tbl.rows) and contract locally.
+
+    Phase 1 walks root -> this shard's frontier; because the shard is a
+    contiguous BFS range, its frontier nodes are a contiguous range at the
+    frontier level, reachable by expanding all of phase 1 (cheap: width F)
+    and slicing the local window with a dynamic slice on the node axis.
+    """
+    rows = tbl.shape[0]
+    e = tbl.shape[1]
+    bsz = last.shape[0]
+    c = chunk_leaves
+    f_local = rows // c                      # frontier nodes owned locally
+    f_total = n_total // c                   # global frontier width
+    f_levels = int(np.log2(f_total))
+
+    seeds = last[:, None, :]
+    for l in range(f_levels):
+        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method)
+    # take the local frontier window [row0/c, row0/c + f_local)
+    node0 = row0 // c
+    seeds = jax.lax.dynamic_slice_in_dim(seeds, node0, f_local, axis=1)
+
+    def expand_subtree(node_seeds):
+        s = node_seeds[:, None, :]
+        for l in range(f_levels, depth):
+            s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method)
+        return s[..., 0].astype(jnp.int32)
+
+    tbl_chunks = tbl.reshape(f_local, c, e)
+    if f_local == 1:
+        return expand._dot_i32(expand_subtree(seeds[:, 0, :]), tbl_chunks[0])
+
+    frontier = jnp.moveaxis(seeds, 1, 0)  # [f_local, B, 4]
+
+    def body(acc, xs):
+        node_seeds, chunk = xs
+        return acc + expand._dot_i32(expand_subtree(node_seeds), chunk), None
+
+    acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
+    # inside shard_map the scan carry must be typed as varying over the
+    # mesh axes (the body's output is), or the carry types mismatch
+    acc0 = jax.lax.pvary(acc0, ("batch", "table"))
+    acc, _ = jax.lax.scan(body, acc0, (frontier, tbl_chunks))
+    return acc
+
+
+class ShardedDPFServer:
+    """Convenience server wrapper: one table, mesh-parallel evaluation.
+
+    The multi-chip counterpart of ``DPF.eval_init``/``eval_tpu``.
+    """
+
+    def __init__(self, table, mesh: Mesh | None = None, prf_method: int = 3,
+                 batch_size: int = 512):
+        from ..core import keygen  # local import to avoid cycles
+        self._keygen = keygen
+        self.mesh = mesh if mesh is not None else make_mesh()
+        tbl = np.asarray(table, dtype=np.int32)
+        self.n, self.entry_size = tbl.shape
+        assert self.n & (self.n - 1) == 0
+        self.depth = self.n.bit_length() - 1
+        self.prf_method = prf_method
+        self.batch_size = batch_size
+        self.table_sharded = shard_table(tbl, self.mesh)
+        shard_rows = self.n // self.mesh.shape["table"]
+        self.chunk = min(expand.choose_chunk(self.n, batch_size), shard_rows)
+
+    def eval(self, keys) -> np.ndarray:
+        if not keys:
+            raise ValueError("empty key batch")
+        flat = [self._keygen.deserialize_key(k) for k in keys]
+        for fk in flat:
+            if fk.n != self.n:
+                raise ValueError("key generated for n=%d but table has n=%d"
+                                 % (fk.n, self.n))
+        eff = len(flat)
+        nb = self.mesh.shape["batch"]
+        pad = (-eff) % max(nb, 1)
+        flat = flat + [flat[-1]] * pad
+        cw1, cw2, last = expand.pack_keys(flat)
+        out = eval_sharded(cw1, cw2, last, self.table_sharded,
+                           depth=self.depth, prf_method=self.prf_method,
+                           chunk_leaves=self.chunk, mesh=self.mesh)
+        return np.asarray(out)[:eff]
