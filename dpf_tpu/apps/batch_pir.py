@@ -1,0 +1,326 @@
+"""Batch-PIR scheduling optimizer: hot/cold split, co-location, binning.
+
+Capability port of the reference's batch-PIR layer
+(``paper/experimental/batch_pir/batch_pir_optimization.py:24-267``): given
+train/validation *access patterns* (lists of index sets, e.g. the embedding
+rows a user's inference touches), plan private batched lookups that maximize
+the fraction of needed entries recovered under a budget of DPF queries:
+
+* **hot/cold split** — the most frequently accessed ``cache_fraction`` of
+  entries form a small "hot" table served with cheaper queries (ref ``:66-83``).
+* **binning** — each table is cut into bins; one DPF query retrieves exactly
+  one entry per bin, so a batch of needed indices spread over many bins is
+  served by few queries (ref ``:49-64``).
+* **co-location** — entries frequently co-accessed with x are stored in x's
+  row, so recovering x recovers them for free (ref ``:198-248``).
+* **cost model** — ``DPFCost(computation, upload, download)`` with the same
+  2-KB/log2(n) key-size accounting (ref ``:85-88,187-194``).
+
+Beyond the reference (which only *models* the protocol), ``PrivateLookupClient``
+/ ``PrivateLookupServer`` execute the planned queries for real through the
+TPU DPF backend.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter, defaultdict
+from dataclasses import asdict, dataclass, field
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class HotColdConfig:
+    cache_size_fraction: float = 1.0
+
+
+@dataclass(frozen=True)
+class CollocateConfig:
+    num_collocate: int = 0
+
+
+@dataclass(frozen=True)
+class PIRConfig:
+    bin_fraction: float = 0.1      # fraction of a table forming one bin
+    entry_size_bytes: int = 256
+    queries_to_hot: int = 1
+    queries_to_cold: int = 0
+
+
+@dataclass
+class DPFCost:
+    computation: int = 0
+    upload_communication: int = 0
+    download_communication: int = 0
+
+    def _asdict(self):
+        return asdict(self)
+
+
+def dpf_key_cost_bytes(table_size: int) -> int:
+    """Upload bytes per query: 16 B x 4 x log2(n) (ref ``:85-88``)."""
+    if table_size <= 1:
+        return 0
+    return int(np.ceil(16 * 4 * np.log2(table_size)))
+
+
+class BatchPIROptimize:
+    """Plan (and cost) private batched lookups over access patterns."""
+
+    def __init__(self, train_set, validation_set,
+                 hotcold_config: HotColdConfig = HotColdConfig(),
+                 collocate_config: CollocateConfig = CollocateConfig(),
+                 pir_config: PIRConfig = PIRConfig(),
+                 collocate_cache: str | dict | None = None):
+        self.hotcold_config = hotcold_config
+        self.collocate_config = collocate_config
+        self.pir_config = pir_config
+        self.train = [list(s) for s in train_set]
+        self.val = [list(s) for s in validation_set]
+
+        self._count_accesses()
+        self._split_hot_cold()
+        self._build_collocation(collocate_cache)
+        self._build_bins()
+        self.accuracy_stats = None
+        self.cost = DPFCost()
+
+    # -------------------------------------------------------- statistics
+
+    def _count_accesses(self):
+        self.embedding_counts = Counter()
+        for idx_set in self.train:
+            self.embedding_counts.update(idx_set)
+        self.all_embedding_indices = set(self.embedding_counts)
+        for idx_set in self.val:
+            self.all_embedding_indices.update(idx_set)
+        self.num_embeddings = len(self.all_embedding_indices)
+
+    def _split_hot_cold(self):
+        frac = self.hotcold_config.cache_size_fraction
+        n_hot = int(frac * self.num_embeddings)
+        by_freq = sorted(self.all_embedding_indices,
+                         key=lambda x: self.embedding_counts[x], reverse=True)
+        self.hot_table = by_freq[:n_hot]
+        self.cold_table = by_freq[n_hot:]
+        # shuffle within each table so bins are unbiased — must be stable
+        # ACROSS PROCESSES (client and server derive bins independently),
+        # so use a keyed digest, not the per-process-salted builtin hash()
+        def stable_key(x):
+            import hashlib
+            return hashlib.sha256(str(x).encode()).digest()
+        self.hot_table.sort(key=stable_key)
+        self.cold_table.sort(key=stable_key)
+
+    def _build_collocation(self, cache):
+        """Top co-accessed neighbors per entry (cacheable: it is O(sum k^2))."""
+        k = self.collocate_config.num_collocate
+        if isinstance(cache, str) and os.path.exists(cache):
+            with open(cache) as f:
+                loaded = json.load(f)
+            self.collocation_map = {int(i): v for i, v in loaded.items()}
+            return
+        if isinstance(cache, dict):
+            self.collocation_map = {int(i): v for i, v in cache.items()}
+            return
+        co = defaultdict(Counter)
+        if k > 0:
+            for idx_set in self.train:
+                uniq = set(idx_set)
+                for src in uniq:
+                    for dst in uniq:
+                        if src != dst:
+                            co[src][dst] += 1
+        self.collocation_map = {
+            idx: [d for d, _ in co[idx].most_common(k)] if idx in co else []
+            for idx in self.all_embedding_indices}
+        if isinstance(cache, str):
+            with open(cache, "w") as f:
+                json.dump(self.collocation_map, f)
+
+    def _build_bins(self):
+        def bins_of(table):
+            if not table:
+                return [], 0
+            per_bin = max(1, int(len(table) * self.pir_config.bin_fraction))
+            return ([set(table[i:i + per_bin])
+                     for i in range(0, len(table), per_bin)], per_bin)
+
+        self.hot_table_bins, self.hot_entries_per_bin = bins_of(self.hot_table)
+        self.cold_table_bins, self.cold_entries_per_bin = \
+            bins_of(self.cold_table)
+
+    # -------------------------------------------------------------- fetch
+
+    def fetch(self, batch_indices):
+        """Greedy multi-query plan for one batch of needed indices.
+
+        Returns (recovered index set, DPFCost).  Each query round retrieves
+        at most one entry per bin; the most-needed unrecovered candidate in
+        each bin wins (ref ``:144-196``).
+        """
+        counts = Counter(batch_indices)
+        targets = set(counts)
+        recovered = set()
+
+        def one_query(bins):
+            for b in bins:
+                cands = b & targets
+                if not cands:
+                    continue
+                best = max(cands, key=lambda x: (-1, 0) if x in recovered
+                           else (0, counts[x]))
+                if best not in recovered:
+                    recovered.add(best)
+
+        for _ in range(self.pir_config.queries_to_hot):
+            one_query(self.hot_table_bins)
+        for _ in range(self.pir_config.queries_to_cold):
+            one_query(self.cold_table_bins)
+
+        collocated = set()
+        for idx in recovered:
+            collocated.update(self.collocation_map.get(idx, []))
+        all_recovered = recovered | collocated
+
+        qh, qc = (self.pir_config.queries_to_hot,
+                  self.pir_config.queries_to_cold)
+        cost = DPFCost(
+            computation=qh * len(self.hot_table) + qc * len(self.cold_table),
+            upload_communication=(
+                qh * dpf_key_cost_bytes(self.hot_entries_per_bin)
+                * len(self.hot_table_bins)
+                + qc * dpf_key_cost_bytes(self.cold_entries_per_bin)
+                * len(self.cold_table_bins)),
+            download_communication=(
+                (qh * len(self.hot_table_bins)
+                 + qc * len(self.cold_table_bins))
+                * self.pir_config.entry_size_bytes))
+        return all_recovered, cost
+
+    # ---------------------------------------------------------- evaluate
+
+    def evaluate(self, limit=None):
+        """Fraction-of-batch-recovered over the validation access patterns."""
+        self.percentage_of_query_recovered = []
+        for val in self.val[:limit]:
+            if not val:
+                continue
+            recovered, self.cost = self.fetch(val)
+            hit = set(x for x in recovered if x in val)
+            self.percentage_of_query_recovered.append(
+                len(hit) / len(set(val)))
+        return self.percentage_of_query_recovered
+
+    def evaluate_with_model(self, dataset_module, limit=None):
+        """Evaluate + downstream model accuracy with unrecovered embeddings
+        masked (the accuracy-vs-PIR-budget experiment, ref ``:114-118``)."""
+        self.evaluate(limit=limit)
+        self.accuracy_stats = dataset_module.evaluate(self)
+        return self.accuracy_stats
+
+    def summarize_evaluation(self):
+        p = self.percentage_of_query_recovered
+        summary = {
+            "pir_config": asdict(self.pir_config),
+            "hotcold_config": asdict(self.hotcold_config),
+            "collocate_config": asdict(self.collocate_config),
+            "mean_recovered": float(np.mean(p)),
+            **{"recovered_p_%d" % q: float(np.percentile(p, q))
+               for q in (0, 5, 10, 50, 90, 95)},
+            "cost": self.cost._asdict(),
+            "accuracy_stats": self.accuracy_stats,
+            "extra": {
+                "hot_table_size": len(self.hot_table),
+                "cold_table_size": len(self.cold_table),
+                "hot_table_entries_per_bin": self.hot_entries_per_bin,
+                "cold_table_entries_per_bin": self.cold_entries_per_bin,
+            },
+        }
+        return summary
+
+
+# ---------------------------------------------------------------------------
+# Real execution of a batch-PIR plan through the TPU DPF backend.
+# (The reference models the protocol analytically; this runs it.)
+# ---------------------------------------------------------------------------
+
+def _pad_pow2(n, lo=128):
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+class PrivateLookupServer:
+    """Holds one bin-structured table; answers DPF queries per bin.
+
+    Each bin is padded to a power-of-two mini-table served by the TPU
+    backend; one batched eval answers one query round across all bins.
+    """
+
+    def __init__(self, table: np.ndarray, bins, prf=None):
+        from ..api import DPF
+        self.entry_size = table.shape[1]
+        self.bins = [sorted(b) for b in bins]
+        self.dpfs = []
+        self.bin_sizes = []
+        for b in self.bins:
+            sub = table[b] if b else np.zeros((1, self.entry_size), np.int32)
+            n = _pad_pow2(len(sub))
+            padded = np.zeros((n, self.entry_size), np.int32)
+            padded[:len(sub)] = sub
+            d = DPF(prf=prf)
+            d.eval_init(padded)
+            self.dpfs.append(d)
+            self.bin_sizes.append(n)
+
+    def answer(self, keys_per_bin):
+        """keys_per_bin: one serialized key per bin -> [n_bins, E] shares."""
+        return np.stack([
+            np.asarray(d.eval_tpu([k]))[0]
+            for d, k in zip(self.dpfs, keys_per_bin)])
+
+
+class PrivateLookupClient:
+    """Generates per-bin keys for a planned fetch and recovers entries."""
+
+    def __init__(self, bins, bin_sizes, prf=None):
+        from ..api import DPF
+        self.dpf = DPF(prf=prf)
+        self.bins = [sorted(b) for b in bins]
+        self.bin_sizes = bin_sizes
+        self.index_to_bin = {}
+        for bi, b in enumerate(self.bins):
+            for pos, idx in enumerate(b):
+                self.index_to_bin[idx] = (bi, pos)
+
+    def make_queries(self, wanted):
+        """Pick <=1 wanted index per bin; others get a dummy (position 0).
+
+        Returns (keys for server A, keys for server B, plan) where plan[bin]
+        is the table index retrieved there (or None for dummy queries —
+        indistinguishable from real ones to each server).
+        """
+        plan = [None] * len(self.bins)
+        for idx in wanted:
+            if idx in self.index_to_bin:
+                bi, _ = self.index_to_bin[idx]
+                if plan[bi] is None:
+                    plan[bi] = idx
+        ka, kb = [], []
+        for bi, target in enumerate(plan):
+            pos = self.index_to_bin[target][1] if target is not None else 0
+            k1, k2 = self.dpf.gen(pos, self.bin_sizes[bi])
+            ka.append(k1)
+            kb.append(k2)
+        return ka, kb, plan
+
+    def recover(self, shares_a, shares_b, plan):
+        """-> dict {table index: entry row} for the non-dummy queries."""
+        diff = (np.asarray(shares_a, np.int64)
+                - np.asarray(shares_b, np.int64)).astype(np.int32)
+        return {target: diff[bi] for bi, target in enumerate(plan)
+                if target is not None}

@@ -1,0 +1,100 @@
+"""Workload models + sweep + codesign tests (reference §2.2 #24-28)."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.apps import codesign, sweep
+from dpf_tpu.apps.batch_pir import (BatchPIROptimize, CollocateConfig,
+                                    HotColdConfig, PIRConfig)
+from dpf_tpu.models import datasets
+
+
+@pytest.fixture(scope="module")
+def rec_setup():
+    from dpf_tpu.models import rec
+    ds = datasets.make_rec_dataset(n_items=300, n_users=80,
+                                   samples_per_user=4, seed=1)
+    model, params = rec.train_rec_model(ds, epochs=3, seed=1)
+    return ds, model, params
+
+
+def test_rec_model_learns(rec_setup):
+    from dpf_tpu.models import rec
+    ds, model, params = rec_setup
+    stats = rec.evaluate_with_pir(model, params, ds, None)
+    assert stats["roc_auc"] > 0.55  # learned something real
+
+
+def test_rec_accuracy_degrades_without_pir_recovery(rec_setup):
+    """Core codesign property: less PIR budget => worse model accuracy."""
+    from dpf_tpu.models import rec
+    ds, model, params = rec_setup
+    train_p = ds.access_patterns("train")
+    val_p = ds.access_patterns("val")
+
+    def auc(queries):
+        opt = BatchPIROptimize(
+            train_p, val_p, HotColdConfig(1.0), CollocateConfig(0),
+            PIRConfig(bin_fraction=0.02, queries_to_hot=queries))
+        return rec.evaluate_with_pir(model, params, ds, opt)["roc_auc"]
+
+    full = rec.evaluate_with_pir(model, params, ds, None)["roc_auc"]
+    rich = auc(8)    # generous budget: ~everything recovered
+    poor = auc(0)    # no queries: all embeddings masked
+    assert rich > poor
+    assert abs(full - rich) < 0.15
+
+
+def test_lm_with_pir_masking():
+    from dpf_tpu.models import lm
+    ds = datasets.make_lm_dataset(vocab_size=150, seq_len=12, n_train=60,
+                                  n_val=8, seed=2)
+    model, params = lm.train_lm(ds, epochs=1, seed=2)
+    full = lm.evaluate_with_pir(model, params, ds, None)
+    opt = BatchPIROptimize(
+        ds.access_patterns("train"), ds.access_patterns("val"),
+        HotColdConfig(1.0), CollocateConfig(0),
+        PIRConfig(bin_fraction=0.5, queries_to_hot=1))
+    masked = lm.evaluate_with_pir(model, params, ds, opt)
+    assert masked["perplexity"] >= full["perplexity"] * 0.9  # no free lunch
+
+
+def test_sweep_writes_results(tmp_path):
+    pats = datasets.make_rec_dataset(
+        n_items=100, n_users=30, samples_per_user=3).access_patterns("train")
+    grid = {"cache_size_fraction": [1.0], "num_collocate": [0],
+            "bin_fraction": [0.1, 0.3], "queries_to_hot": [1, 4],
+            "queries_to_cold": [0]}
+    res = sweep.run_sweep(pats, pats, out_dir=str(tmp_path), grid=grid)
+    assert len(res) == 4
+    assert all("mean_recovered" in r for r in res)
+    # cache: second run loads from disk
+    res2 = sweep.run_sweep(pats, pats, out_dir=str(tmp_path), grid=grid)
+    assert len(res2) == 4
+    # more queries never recovers less (same bin fraction)
+    by_cfg = {(r["config"]["bin_fraction"], r["config"]["queries_to_hot"]):
+              r["mean_recovered"] for r in res}
+    assert by_cfg[(0.1, 4)] >= by_cfg[(0.1, 1)]
+
+
+def test_codesign_join():
+    pats = datasets.make_rec_dataset(
+        n_items=100, n_users=30, samples_per_user=3).access_patterns("train")
+    grid = {"cache_size_fraction": [0.5, 1.0], "num_collocate": [0],
+            "bin_fraction": [0.2], "queries_to_hot": [1, 2],
+            "queries_to_cold": [0, 1]}
+    res = sweep.run_sweep(pats, pats, grid=grid)
+    perf = [
+        {"entries": 128, "dpfs_per_sec": 100000.0},
+        {"entries": 16384, "dpfs_per_sec": 50000.0},
+    ]
+    pts = codesign.join_sweep_with_perf(res, perf)
+    assert len(pts) == len(res)
+    for p in pts:
+        assert p["latency_ms"] > 0 and p["queries_per_sec"] > 0
+        assert p["upload_bytes"] > 0
+    fr = codesign.pareto_frontier(pts)
+    assert 1 <= len(fr) <= len(pts)
+    # frontier is sorted by latency and strictly improving recovery
+    recs = [p["mean_recovered"] for p in fr]
+    assert recs == sorted(recs)
