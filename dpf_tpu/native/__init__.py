@@ -1,0 +1,106 @@
+"""ctypes loader for the native DPF runtime (builds on demand, falls back).
+
+The native library accelerates the host-side paths (keygen, eval_cpu) the
+way the reference's C++ core does (``dpf_base/dpf.h``); the TPU path never
+needs it.  If no compiler is available the pure-Python implementations are
+used transparently.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+_THIS = os.path.dirname(__file__)
+_SRC = os.path.join(_THIS, "src", "dpftpu.cpp")
+_LIB = os.path.join(_THIS, "libdpftpu.so")
+
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+           "-o", _LIB, _SRC]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        # -march=native may be unavailable in exotic setups; retry plain
+        try:
+            subprocess.run([c for c in cmd if c != "-march=native"],
+                           check=True, capture_output=True)
+            return True
+        except (subprocess.CalledProcessError, FileNotFoundError):
+            return False
+
+
+def load():
+    """Returns the ctypes library handle, or None if unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_LIB) or (
+            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+        if not _build():
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB)
+    except OSError:
+        return None
+    lib.dpftpu_gen.argtypes = [
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint64,
+        ctypes.c_int, ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.dpftpu_gen.restype = ctypes.c_int
+    lib.dpftpu_eval_expand.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.dpftpu_eval_expand.restype = ctypes.c_int
+    lib.dpftpu_eval_point.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint32)]
+    lib.dpftpu_eval_point.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def gen(alpha: int, n: int, seed: bytes, prf_method: int):
+    """Native keygen -> two [524] int32 numpy arrays (or None if no lib)."""
+    import numpy as np
+    lib = load()
+    if lib is None:
+        return None
+    k0 = np.zeros(524, dtype=np.int32)
+    k1 = np.zeros(524, dtype=np.int32)
+    rc = lib.dpftpu_gen(
+        alpha, n, seed, len(seed), prf_method,
+        k0.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        k1.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        raise ValueError("native keygen failed (rc=%d)" % rc)
+    return k0, k1
+
+
+def eval_expand(key, prf_method: int):
+    """Native full expansion -> [n] int32 (natural order), or None."""
+    import numpy as np
+    lib = load()
+    if lib is None:
+        return None
+    arr = np.ascontiguousarray(np.asarray(key, dtype=np.int32).reshape(-1))
+    n = int(arr.view(np.uint32)[520])  # wire slot 130 limb 0
+    n |= int(arr.view(np.uint32)[521]) << 32
+    out = np.zeros(n, dtype=np.int32)
+    rc = lib.dpftpu_eval_expand(
+        arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), prf_method,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        raise ValueError("native eval failed (rc=%d)" % rc)
+    return out

@@ -1,0 +1,52 @@
+"""Native C++ runtime tests: byte-identical keygen, exact evaluation,
+graceful fallback wiring."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu import DPF, native
+from dpf_tpu.core import evalref, keygen
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+@pytest.mark.parametrize("method", [0, 1, 2, 3])
+def test_native_keygen_matches_python(method):
+    for n, alpha in ((128, 0), (1024, 1023), (4096, 1234)):
+        kn = native.gen(alpha, n, b"seed-%d" % alpha, method)
+        kp = keygen.generate_keys(alpha, n, b"seed-%d" % alpha, method)
+        assert (kn[0] == kp[0].serialize()).all()
+        assert (kn[1] == kp[1].serialize()).all()
+
+
+@pytest.mark.parametrize("method", [0, 1, 2, 3])
+def test_native_expand_matches_numpy(method):
+    n, alpha = 512, 499
+    kn0, kn1 = native.gen(alpha, n, b"exp", method)
+    fp0 = keygen.deserialize_key(kn0)
+    assert (native.eval_expand(kn0, method)
+            == evalref.eval_one_hot_i32(fp0, method)).all()
+    d = (native.eval_expand(kn0, method).view(np.uint32)
+         - native.eval_expand(kn1, method).view(np.uint32))
+    gt = np.zeros(n, np.uint32)
+    gt[alpha] = 1
+    assert (d == gt).all()
+
+
+def test_native_rejects_bad_input():
+    with pytest.raises(ValueError):
+        native.gen(5, 100, b"x", 0)  # not a power of two
+
+
+def test_api_uses_native_transparently():
+    """DPF.gen/eval_cpu must behave identically with the native fast path."""
+    n = 256
+    dpf = DPF(prf=DPF.PRF_CHACHA20)
+    k1, k2 = dpf.gen(99, n, seed=b"api-native")
+    # determinism across backends: the Python DRBG gives the same keys
+    kp = keygen.generate_keys(99, n, b"api-native", DPF.PRF_CHACHA20)
+    assert (np.asarray(k1) == kp[0].serialize()).all()
+    hots = np.asarray(dpf.eval_cpu([k1, k2], one_hot_only=True))
+    d = (hots[0].view(np.uint32) - hots[1].view(np.uint32))
+    assert d[99] == 1 and (np.delete(d, 99) == 0).all()
