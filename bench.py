@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Driver benchmark: prints ONE JSON line with the headline metric.
+
+Headline: server-side batched DPF evaluation throughput (dpfs/sec) at
+entries=65536, entry_size=16, PRF=AES-128, batch=512 on one TPU chip —
+the reference's V100 number for this config is 15,392 dpfs/sec
+(README.md:130); vs_baseline = ours / V100.
+"""
+
+import json
+import sys
+
+BASELINE_V100_AES128_65536 = 15392.0
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    import dpf_tpu
+    from dpf_tpu.utils.bench import test_dpf_perf
+
+    r = test_dpf_perf(N=n, batch=512, entrysize=16,
+                      prf=dpf_tpu.PRF_AES128, reps=10, quiet=True)
+    print(json.dumps({
+        "metric": "dpfs/sec (entries=%d, entry_size=16, AES128, batch=512, "
+                  "1 chip)" % n,
+        "value": r["dpfs_per_sec"],
+        "unit": "dpfs/sec",
+        "vs_baseline": round(r["dpfs_per_sec"] / BASELINE_V100_AES128_65536,
+                             4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

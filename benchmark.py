@@ -1,0 +1,11 @@
+# benchmark.py — sweep table sizes x PRFs and print dpfs/sec
+# (mirrors the reference's benchmark.py:1-7 sweep protocol).
+
+import dpf_tpu
+from dpf_tpu.utils.bench import test_dpf_perf
+
+if __name__ == "__main__":
+    for n in [16384, 65536, 262144, 1048576]:
+        for prf in [dpf_tpu.PRF_AES128, dpf_tpu.PRF_SALSA20,
+                    dpf_tpu.PRF_CHACHA20]:
+            test_dpf_perf(N=n, prf=prf)

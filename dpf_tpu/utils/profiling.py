@@ -1,0 +1,43 @@
+"""Profiling helpers (the reference's Nsight-Compute role, SURVEY.md §5).
+
+``jax.profiler`` traces viewable in XProf/Perfetto replace ``ncu``; the
+trace directory naming mirrors the reference's artifact-per-config scheme
+(``paper/kernel/gpu/Makefile:24-26``).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+
+
+@contextlib.contextmanager
+def trace(config_name: str, base_dir: str = "/tmp/dpf_tpu_traces"):
+    """Capture a jax.profiler trace named after the benchmark config."""
+    import jax
+    path = os.path.join(base_dir, config_name)
+    os.makedirs(path, exist_ok=True)
+    jax.profiler.start_trace(path)
+    try:
+        yield path
+    finally:
+        jax.profiler.stop_trace()
+
+
+class Timer:
+    """Wall-clock block timer that blocks on device completion."""
+
+    def __init__(self):
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        import jax
+        # drain any async dispatch before stopping the clock
+        jax.block_until_ready(jax.numpy.zeros(()))
+        self.elapsed = time.perf_counter() - self._t0
+        return False
