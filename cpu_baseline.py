@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""CPU-baseline benchmark harness (role of the reference's
+``paper/kernel/cpu/dpf_google/benchmark.cu`` + its thread-sweep script):
+measures native multithreaded CPU DPF expansion + fused contraction so the
+TPU speedup tables have an in-repo CPU column.
+
+Usage:
+  python cpu_baseline.py [n_entries] [entry_size] [batch] [reps] [threads]
+  python cpu_baseline.py --sweep     # thread sweep 1..N like the reference
+
+Prints one python-dict result line per config (the printed-dict protocol).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run(n_entries=16384, entry_size=16, batch=64, reps=3, threads=1,
+        prf=3):
+    import dpf_tpu
+    from dpf_tpu import native
+
+    if not native.available():
+        print(json.dumps({"error": "native library unavailable"}))
+        return None
+    d = dpf_tpu.DPF(prf=prf)
+    keys = [d.gen(int(i * 997) % n_entries, n_entries)[0]
+            for i in range(min(batch, 16))]
+    keys = [keys[i % len(keys)] for i in range(batch)]
+    table = np.random.randint(0, 2 ** 31, (n_entries, entry_size),
+                              dtype=np.int64).astype(np.int32)
+
+    native.eval_contract(keys[:2], prf, table, n_threads=threads)  # warm
+    t0 = time.time()
+    for _ in range(reps):
+        native.eval_contract(keys, prf, table, n_threads=threads)
+    elapsed = time.time() - t0
+    result = {
+        "backend": "cpu-native",
+        "entries": n_entries,
+        "entry_size": entry_size,
+        "batch_size": batch,
+        "threads": threads,
+        "prf": d.prf_method_string,
+        "reps": reps,
+        "elapsed_s": round(elapsed, 4),
+        "dpfs_per_sec": int(batch * reps / elapsed),
+    }
+    print(json.dumps(result))
+    return result
+
+
+def thread_sweep(n_entries=16384, max_threads=None):
+    import os
+    if max_threads is None:
+        max_threads = os.cpu_count() or 8
+    t = 1
+    while t <= max_threads:
+        run(n_entries=n_entries, threads=t)
+        t *= 2
+
+
+if __name__ == "__main__":
+    if "--sweep" in sys.argv:
+        thread_sweep()
+    else:
+        args = [int(a) for a in sys.argv[1:]]
+        names = ["n_entries", "entry_size", "batch", "reps", "threads"]
+        run(**dict(zip(names, args)))

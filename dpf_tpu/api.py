@@ -181,9 +181,11 @@ class DPF(object):
         cw1, cw2, last = expand.pack_keys(flat)
         depth = n.bit_length() - 1
         chunk = expand.choose_chunk(n, len(flat))
+        from .ops import matmul128
         out = expand.expand_and_contract(
             cw1, cw2, last, self.table_device, depth=depth,
-            prf_method=self.prf_method, chunk_leaves=chunk)
+            prf_method=self.prf_method, chunk_leaves=chunk,
+            dot_impl=matmul128.default_impl())
         return np.asarray(out)
 
     # ------------------------------------------------------------ eval_cpu

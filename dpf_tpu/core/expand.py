@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import u128
-from .prf import prf_v
+from .prf import prf_pair
 
 MAX_CW = 64  # codeword slots in the wire format (2 per level, depth <= 32)
 
@@ -53,11 +53,12 @@ def choose_chunk(n: int, batch: int) -> int:
 def _level_step(seeds, cw1, cw2, i: int, prf_method: int):
     """One GGM level: [B, w, 4] -> [B, 2w, 4].  `i` is the flat level index."""
     sel = (seeds[..., 0] & np.uint32(1)).astype(bool)[..., None]  # [B, w, 1]
+    prf_out = prf_pair(prf_method, seeds)
     children = []
     for b in (0, 1):
         cw = jnp.where(sel, cw2[:, None, 2 * i + b, :],
                        cw1[:, None, 2 * i + b, :])        # [B, w, 4]
-        children.append(u128.add128(prf_v(prf_method, seeds, b), cw))
+        children.append(u128.add128(prf_out[b], cw))
     stacked = jnp.stack(children, axis=2)                 # [B, w, 2, 4]
     bsz, w = seeds.shape[0], seeds.shape[1]
     return stacked.reshape(bsz, 2 * w, 4)
@@ -70,9 +71,10 @@ def permute_table(table_i32: np.ndarray) -> np.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "prf_method",
-                                             "chunk_leaves"))
+                                             "chunk_leaves", "dot_impl"))
 def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
-                        prf_method: int, chunk_leaves: int):
+                        prf_method: int, chunk_leaves: int,
+                        dot_impl: str = "i32"):
     """Batched fused DPF evaluation.
 
     Args:
@@ -107,24 +109,26 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
 
     if f == 1:
         leaves = expand_subtree(seeds[:, 0, :])
-        return _dot_i32(leaves, table_chunks[0])
+        return _dot_i32(leaves, table_chunks[0], dot_impl)
 
     frontier = jnp.moveaxis(seeds, 1, 0)  # [F, B, 4]
 
     def body(acc, xs):
         node_seeds, chunk = xs
         leaves = expand_subtree(node_seeds)         # [B, C] int32
-        return acc + _dot_i32(leaves, chunk), None
+        return acc + _dot_i32(leaves, chunk, dot_impl), None
 
     acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
     acc, _ = lax.scan(body, acc0, (frontier, table_chunks))
     return acc
 
 
-def _dot_i32(a, b):
-    """Exact wrapping int32 matmul: [B, C] x [C, E] -> [B, E] mod 2^32."""
-    return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
-                           preferred_element_type=jnp.int32)
+def _dot_i32(a, b, impl: str | None = None):
+    """Exact wrapping int32 matmul: [B, C] x [C, E] -> [B, E] mod 2^32.
+
+    Delegates to ops.matmul128 (switchable VPU int32 vs MXU int8-limb)."""
+    from ..ops import matmul128
+    return matmul128.dot(a, b, impl)
 
 
 def expand_leaves(cw1, cw2, last, *, depth: int, prf_method: int):

@@ -7,9 +7,9 @@ to a batch of 128-bit PRF outputs, matching the scalar semantics in
 
 The implementations are backend generic (NumPy for the host reference path,
 jax.numpy inside jit for TPU): Salsa/ChaCha are pure 32-bit add/xor/rotate
-chains that XLA fuses into long VPU pipelines; AES-128 ships in two flavors —
-a byte-table gather version (simple, used on host) and a *bitsliced* version
-(boolean algebra over 128 bit-planes, no gathers) which is what runs on TPU.
+chains that XLA fuses into long VPU pipelines; AES-128 uses byte-plane
+S-box gathers with the key schedule fused per round (and shared between the
+two GGM child positions via ``prf_pair``).
 
 Reference semantics: ``dpf_base/dpf.h:65-235`` and ``dpf_gpu/prf/prf.cu``.
 """
@@ -236,7 +236,25 @@ def prf_aes128_v(seeds, pos: int):
 # log2(N) levels), which explodes XLA compile time.  These variants put the
 # round loop in lax.fori_loop so each PRF body is compiled once per level:
 # identical arithmetic, ~10x smaller HLO.
+#
+# Runtime trade-off: a rolled fori_loop materializes its [16, B, w] carry in
+# HBM every iteration (the cipher is memory-bound that way); fully unrolling
+# lets XLA fuse all rounds into one elementwise kernel.  ``ROUND_UNROLL``
+# picks per backend: unroll on TPU (fast compiles there), rolled elsewhere
+# (CPU XLA chokes on the big graphs).  Override by setting the module flag.
 # ---------------------------------------------------------------------------
+
+ROUND_UNROLL = None  # None = auto (unroll on TPU), True/False = force
+
+
+def _round_unroll() -> bool:
+    if ROUND_UNROLL is not None:
+        return bool(ROUND_UNROLL)
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
 
 def _salsa_state(seeds, pos: int):
     import jax.numpy as jnp
@@ -269,7 +287,8 @@ def prf_salsa20_12_jax(seeds, pos: int):
             x[a] = x[a] ^ _rotl(x[d] + x[c], 18)
         return jnp.stack(x)
 
-    x = jax.lax.fori_loop(0, 6, double_round, init)
+    x = jax.lax.fori_loop(0, 6, double_round, init,
+                          unroll=_round_unroll())
     out = x + init
     return u128._stack_last([out[4], out[3], out[2], out[1]])
 
@@ -305,7 +324,8 @@ def prf_chacha20_12_jax(seeds, pos: int):
             x[b] = _rotl(x[b] ^ x[c], 7)
         return jnp.stack(x)
 
-    x = jax.lax.fori_loop(0, 6, double_round, init)
+    x = jax.lax.fori_loop(0, 6, double_round, init,
+                          unroll=_round_unroll())
     out = x + init
     return u128._stack_last([out[7], out[6], out[5], out[4]])
 
@@ -317,6 +337,32 @@ _RCON = np.array([0, 1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36],
 # new[4c + r] = old[4*((c + r) % 4) + r]
 _SHIFT_ROWS = np.array([(4 * ((i // 4 + i % 4) % 4)) + i % 4
                         for i in range(16)])
+
+
+
+def _aes_next_round_key_jax(sbox, rcon, rk, rnd):
+    """One AES-128 key-schedule step on [16, ...] byte planes (shared by
+    the single-call and fused-pair variants — keep them bit-identical)."""
+    import jax.numpy as jnp
+    t = [sbox[rk[13]] ^ rcon[rnd], sbox[rk[14]], sbox[rk[15]], sbox[rk[12]]]
+    w = [rk[i] ^ t[i] for i in range(4)]
+    for i in range(4, 16):
+        w.append(w[i - 4] ^ rk[i])
+    return jnp.stack(w)
+
+
+def _aes_mix_columns_jax(x):
+    """MixColumns on [16, ...] byte planes."""
+    import jax.numpy as jnp
+    ns = []
+    for c in range(4):
+        a = [x[4 * c + r] for r in range(4)]
+        t = a[0] ^ a[1] ^ a[2] ^ a[3]
+        ns.append(a[0] ^ t ^ _xtime_v(a[0] ^ a[1]))
+        ns.append(a[1] ^ t ^ _xtime_v(a[1] ^ a[2]))
+        ns.append(a[2] ^ t ^ _xtime_v(a[2] ^ a[3]))
+        ns.append(a[3] ^ t ^ _xtime_v(a[3] ^ a[0]))
+    return jnp.stack(ns)
 
 
 def prf_aes128_jax(seeds, pos: int):
@@ -334,23 +380,9 @@ def prf_aes128_jax(seeds, pos: int):
     rcon = jnp.asarray(_RCON)
 
     def next_round_key(rk, rnd):
-        t = [sbox[rk[13]] ^ rcon[rnd], sbox[rk[14]], sbox[rk[15]],
-             sbox[rk[12]]]
-        w = [rk[i] ^ t[i] for i in range(4)]
-        for i in range(4, 16):
-            w.append(w[i - 4] ^ rk[i])
-        return jnp.stack(w)
+        return _aes_next_round_key_jax(sbox, rcon, rk, rnd)
 
-    def mix_columns(x):
-        ns = []
-        for c in range(4):
-            a = [x[4 * c + r] for r in range(4)]
-            t = a[0] ^ a[1] ^ a[2] ^ a[3]
-            ns.append(a[0] ^ t ^ _xtime_v(a[0] ^ a[1]))
-            ns.append(a[1] ^ t ^ _xtime_v(a[1] ^ a[2]))
-            ns.append(a[2] ^ t ^ _xtime_v(a[2] ^ a[3]))
-            ns.append(a[3] ^ t ^ _xtime_v(a[3] ^ a[0]))
-        return jnp.stack(ns)
+    mix_columns = _aes_mix_columns_jax
 
     st = st ^ rk  # round 0
 
@@ -362,7 +394,8 @@ def prf_aes128_jax(seeds, pos: int):
         rk = next_round_key(rk, rnd)
         return (st ^ rk, rk)
 
-    st, rk = jax.lax.fori_loop(1, 10, round_body, (st, rk))
+    st, rk = jax.lax.fori_loop(1, 10, round_body, (st, rk),
+                              unroll=_round_unroll())
     # final round: no MixColumns
     st = sbox[st][_SHIFT_ROWS]
     rk = next_round_key(rk, 10)
@@ -394,3 +427,51 @@ def prf_v(method: int, seeds, pos: int):
     if isinstance(seeds, np.ndarray):
         return PRF_V_NUMPY[method](seeds, pos)
     return PRF_V_JAX[method](seeds, pos)
+
+
+def prf_aes128_pair_jax(seeds):
+    """AES of positions 0 AND 1 under the same per-seed key.
+
+    The GGM level step always needs both children of a node; their AES keys
+    are identical (the seed), so the key schedule — ~1/3 of the per-call
+    work — is computed once and shared between the two encryptions.
+    """
+    import jax
+    import jax.numpy as jnp
+    sbox = jnp.asarray(_SBOX_NP)
+
+    kb = _bytes_of_limbs(seeds)
+    rk = jnp.stack([kb[..., i] for i in range(16)])
+    zero = seeds[..., 0] - seeds[..., 0]
+    rcon = jnp.asarray(_RCON)
+
+    def next_round_key(rk, rnd):
+        return _aes_next_round_key_jax(sbox, rcon, rk, rnd)
+
+    mix_columns = _aes_mix_columns_jax
+
+    # plaintexts 0 and 1 differ only in byte 0
+    st0 = jnp.stack([zero] * 16) ^ rk
+    st1 = jnp.stack([zero + np.uint32(1)] + [zero] * 15) ^ rk
+
+    def round_body(rnd, carry):
+        st0, st1, rk = carry
+        st0 = mix_columns(sbox[st0][_SHIFT_ROWS])
+        st1 = mix_columns(sbox[st1][_SHIFT_ROWS])
+        rk = next_round_key(rk, rnd)
+        return (st0 ^ rk, st1 ^ rk, rk)
+
+    st0, st1, rk = jax.lax.fori_loop(1, 10, round_body, (st0, st1, rk),
+                                     unroll=_round_unroll())
+    rk = next_round_key(rk, 10)
+    st0 = sbox[st0][_SHIFT_ROWS] ^ rk
+    st1 = sbox[st1][_SHIFT_ROWS] ^ rk
+    return (_limbs_of_bytes(u128._stack_last([st0[i] for i in range(16)])),
+            _limbs_of_bytes(u128._stack_last([st1[i] for i in range(16)])))
+
+
+def prf_pair(method: int, seeds):
+    """Both children PRF(seed, 0), PRF(seed, 1) — fused where profitable."""
+    if not isinstance(seeds, np.ndarray) and method == PRF_AES128:
+        return prf_aes128_pair_jax(seeds)
+    return prf_v(method, seeds, 0), prf_v(method, seeds, 1)

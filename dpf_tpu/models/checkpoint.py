@@ -1,0 +1,49 @@
+"""Model checkpoint/resume via Orbax (SURVEY.md §5: the reference persists
+trained workload models to ``model.pt``; this is the TPU-native equivalent).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def save_params(path: str, params) -> str:
+    """Save a flax params pytree; returns the checkpoint path."""
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, params, force=True)
+    ckptr.wait_until_finished()
+    return path
+
+
+def load_params(path: str, like=None):
+    """Load a params pytree saved by save_params.
+
+    `like`: optional abstract/concrete pytree with the target structure
+    (restores exact dtypes/shapes); plain restore otherwise.
+    """
+    import orbax.checkpoint as ocp
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if like is not None:
+        import jax
+        abstract = jax.tree_util.tree_map(ocp.utils.to_shape_dtype_struct,
+                                          like)
+        return ckptr.restore(path, abstract)
+    return ckptr.restore(path)
+
+
+def train_or_restore(path: str, init_fn, train_fn):
+    """Resume-from-checkpoint pattern.
+
+    ``init_fn() -> (model, params_template)`` must be cheap (model.init on
+    dummy inputs); ``train_fn() -> (model, params)`` is the expensive run.
+    Restores from `path` when present, otherwise trains and checkpoints.
+    """
+    if os.path.exists(path):
+        model, template = init_fn()
+        return model, load_params(path, like=template)
+    model, params = train_fn()
+    save_params(path, params)
+    return model, params

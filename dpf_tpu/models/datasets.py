@@ -56,9 +56,11 @@ def make_rec_dataset(n_items=2000, n_users=400, samples_per_user=6,
     item_cluster = rng.integers(0, n_clusters, n_items)
 
     rows = []
+    nonempty_clusters = np.unique(item_cluster)
     for _ in range(n_users):
-        user_cluster = rng.integers(0, n_clusters)
-        # user history: mostly items from their cluster
+        # pick among clusters that actually own items (small n_items can
+        # leave some of the n_clusters empty)
+        user_cluster = int(rng.choice(nonempty_clusters))
         cluster_items = np.where(item_cluster == user_cluster)[0]
         for _ in range(samples_per_user):
             l = int(rng.integers(2, max_hist + 1))

@@ -22,7 +22,7 @@ _tried = False
 
 def _build() -> bool:
     cmd = ["g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
-           "-o", _LIB, _SRC]
+           "-pthread", "-o", _LIB, _SRC]
     try:
         subprocess.run(cmd, check=True, capture_output=True)
         return True
@@ -63,6 +63,11 @@ def load():
         ctypes.POINTER(ctypes.c_int32), ctypes.c_uint64, ctypes.c_int,
         ctypes.POINTER(ctypes.c_uint32)]
     lib.dpftpu_eval_point.restype = ctypes.c_int
+    lib.dpftpu_eval_contract.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_uint64, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.dpftpu_eval_contract.restype = ctypes.c_int
     _lib = lib
     return _lib
 
@@ -103,4 +108,28 @@ def eval_expand(key, prf_method: int):
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
     if rc != 0:
         raise ValueError("native eval failed (rc=%d)" % rc)
+    return out
+
+
+def eval_contract(keys, prf_method: int, table, n_threads: int = 1):
+    """Native batched expand+contract (the CPU baseline): keys [B,524] int32,
+    table [n, E] int32 -> [B, E] int32 shares."""
+    import numpy as np
+    lib = load()
+    if lib is None:
+        return None
+    kb = np.ascontiguousarray(np.stack(
+        [np.asarray(k, dtype=np.int32).reshape(-1) for k in keys]))
+    if kb.shape[1] != 524:
+        raise ValueError("DPF keys must be 524 int32 words, got %d"
+                         % kb.shape[1])
+    tbl = np.ascontiguousarray(np.asarray(table, dtype=np.int32))
+    out = np.zeros((kb.shape[0], tbl.shape[1]), dtype=np.int32)
+    rc = lib.dpftpu_eval_contract(
+        kb.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), kb.shape[0],
+        prf_method, tbl.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        tbl.shape[1], n_threads,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        raise ValueError("native eval_contract failed (rc=%d)" % rc)
     return out

@@ -7,17 +7,21 @@
 // and a SHAKE-256 DRBG byte-identical to the Python keygen, so both paths
 // produce the same keys for the same seed.
 
+#include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 #include "prf.h"
 #include "shake256.h"
 
 namespace dpftpu {
-namespace {
 
 constexpr int kKeyWords = 524;
+
+namespace {
 
 struct FlatKey {
   int depth;
@@ -181,27 +185,44 @@ int dpftpu_eval_point(const int32_t* key, uint64_t indx, int prf_method,
 }
 
 // Batched expansion with fused mod-2^32 contraction against a table:
-// table is [n x entry_size] int32 in natural row order; out is
-// [batch x entry_size] int32.  (The CPU analogue of the TPU fused path;
-// also the multithreaded CPU baseline for speedup tables.)
-int dpftpu_eval_contract(const int32_t* const* keys, uint64_t batch,
-                         int prf_method, const int32_t* table,
-                         uint64_t entry_size, int32_t* out) {
-  for (uint64_t b = 0; b < batch; b++) {
-    dpftpu::FlatKey k;
-    dpftpu::deserialize(keys[b], &k);
-    if (k.depth < 1 || k.depth > 32) return -1;
-    std::vector<int32_t> hot(k.n);
-    dpftpu::expand_all(k, prf_method, hot.data());
-    for (uint64_t e = 0; e < entry_size; e++) {
-      uint32_t acc = 0;
-      for (uint64_t j = 0; j < k.n; j++)
-        acc += static_cast<uint32_t>(hot[j]) *
-               static_cast<uint32_t>(table[j * entry_size + e]);
-      out[b * entry_size + e] = static_cast<int32_t>(acc);
+// keys is batch x 524 int32 (contiguous); table is [n x entry_size] int32
+// in natural row order; out is [batch x entry_size] int32.  Runs the batch
+// across `n_threads` std::threads — the CPU-baseline analogue of the
+// reference's OpenMP harness (paper/kernel/cpu/dpf_google/benchmark.cu),
+// used for the CPU-vs-TPU speedup tables.
+int dpftpu_eval_contract(const int32_t* keys, uint64_t batch, int prf_method,
+                         const int32_t* table, uint64_t entry_size,
+                         int n_threads, int32_t* out) {
+  if (n_threads < 1) n_threads = 1;
+  std::vector<std::thread> workers;
+  std::atomic<int> rc{0};
+  auto work = [&](uint64_t lo, uint64_t hi) {
+    for (uint64_t b = lo; b < hi; b++) {
+      dpftpu::FlatKey k;
+      dpftpu::deserialize(keys + b * dpftpu::kKeyWords, &k);
+      if (k.depth < 1 || k.depth > 32) {
+        rc.store(-1, std::memory_order_relaxed);
+        return;
+      }
+      std::vector<int32_t> hot(k.n);
+      dpftpu::expand_all(k, prf_method, hot.data());
+      for (uint64_t e = 0; e < entry_size; e++) {
+        uint32_t acc = 0;
+        for (uint64_t j = 0; j < k.n; j++)
+          acc += static_cast<uint32_t>(hot[j]) *
+                 static_cast<uint32_t>(table[j * entry_size + e]);
+        out[b * entry_size + e] = static_cast<int32_t>(acc);
+      }
     }
+  };
+  uint64_t per = (batch + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; t++) {
+    uint64_t lo = t * per, hi = std::min(batch, (t + 1) * per);
+    if (lo >= hi) break;
+    workers.emplace_back(work, lo, hi);
   }
-  return 0;
+  for (auto& w : workers) w.join();
+  return rc.load();
 }
 
 int dpftpu_key_words(void) { return dpftpu::kKeyWords; }

@@ -1,0 +1,104 @@
+"""Exact mod-2^32 matmul strategies for the fused DPF contraction.
+
+The server-side contraction is ``out[b,e] = sum_j leaf32[b,j] * table[j,e]
+(mod 2^32)`` (see core/expand.py for why mod 2^32 suffices — the reference
+instead runs a custom 128-bit split-K GEMM, ``dpf_gpu/matmul/matmul.cu``).
+
+Two implementations:
+
+* ``dot_i32`` — single ``dot_general`` on int32.  XLA TPU executes integer
+  dots on the VPU; exact, simple, and fine when the PRF dominates.
+* ``dot_i32_mxu`` — byte-limb decomposition onto the MXU's native
+  int8 x int8 -> int32 path: split both operands into 4 unsigned byte limbs,
+  keep the 10 limb-pair products with shift < 32, run them as int8 matmuls
+  (values biased by -128 into int8 range, corrected with rank-1 terms), and
+  recombine with wrapping shifts.  int32 accumulator overflow is harmless —
+  wrapping is exactly mod-2^32 semantics.
+
+Both are bit-exact; expand.py picks via ``set_dot_impl`` after benchmarking.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def dot_i32(a, b):
+    """[B, K] x [K, E] -> [B, E], wrapping int32."""
+    return lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.int32)
+
+
+def _byte_limbs_signed(x):
+    """int32 [M, N] -> list of 4 int8 arrays, limb k holding byte k - 128.
+
+    Returns (limbs, sums) where sums[k] is the per-row (axis kept) int32 sum
+    of the *unsigned* byte limb, needed for the bias correction.
+    """
+    xu = lax.bitcast_convert_type(x, jnp.uint32)
+    limbs = []
+    usums = []
+    for k in range(4):
+        byte = (xu >> np.uint32(8 * k)) & np.uint32(0xFF)  # [M, N] in 0..255
+        byte_i32 = byte.astype(jnp.int32)
+        limbs.append((byte_i32 - 128).astype(jnp.int8))
+        usums.append(byte_i32)
+    return limbs, usums
+
+
+def dot_i32_mxu(a, b):
+    """MXU-decomposed exact wrapping int32 matmul: [B, K] x [K, E]."""
+    a = a.astype(jnp.int32)
+    b = b.astype(jnp.int32)
+    k_dim = a.shape[1]
+    a_limbs, a_bytes = _byte_limbs_signed(a)
+    b_limbs, b_bytes = _byte_limbs_signed(b)
+    # bias corrections: for u = s + 128,
+    #   U_a @ U_b = S_a@S_b + 128*rowsum(S_a) + 128*colsum(S_b) + 128^2*K
+    # with rowsum/colsum of the SIGNED limbs; compute from unsigned sums:
+    #   rowsum(S_a) = rowsum(U_a) - 128*K
+    a_rowsums = [s.sum(axis=1, keepdims=True) - 128 * k_dim
+                 for s in a_bytes]                        # [B, 1] int32
+    b_colsums = [s.sum(axis=0, keepdims=True) - 128 * k_dim
+                 for s in b_bytes]                        # [1, E] int32
+    bias_const = np.uint32((128 * 128 * k_dim)
+                           & 0xFFFFFFFF).astype(np.int32)
+
+    out = jnp.zeros((a.shape[0], b.shape[1]), dtype=jnp.int32)
+    for i in range(4):
+        for j in range(4 - i):
+            prod = lax.dot_general(a_limbs[i], b_limbs[j],
+                                   (((1,), (0,)), ((), ())),
+                                   preferred_element_type=jnp.int32)
+            term = (prod + 128 * a_rowsums[i] + 128 * b_colsums[j]
+                    + bias_const)
+            out = out + (term << np.int32(8 * (i + j)))
+    return out
+
+
+IMPLS = {"i32": dot_i32, "mxu": dot_i32_mxu}
+
+_DEFAULT_IMPL = "i32"
+
+
+def set_dot_impl(name: str):
+    """Select the default contraction backend: "i32" or "mxu".
+
+    The choice is threaded into jitted programs as a *static* argument
+    (see expand.expand_and_contract), so changing it here retraces —
+    already-compiled executables are never silently stale."""
+    global _DEFAULT_IMPL
+    if name not in IMPLS:
+        raise KeyError(name)
+    _DEFAULT_IMPL = name
+
+
+def default_impl() -> str:
+    return _DEFAULT_IMPL
+
+
+def dot(a, b, impl: str | None = None):
+    return IMPLS[impl or _DEFAULT_IMPL](a, b)

@@ -1,0 +1,72 @@
+"""Contraction-strategy and fused-PRF tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from dpf_tpu.core import prf, prf_ref, u128
+from dpf_tpu.ops import matmul128
+
+
+def _exact_mod32(a, b):
+    obj = (a.astype(np.uint32).astype(object)
+           @ b.astype(np.uint32).astype(object))
+    return (obj % (2 ** 32)).astype(np.uint64).astype(np.uint32)
+
+
+@pytest.mark.parametrize("impl", [matmul128.dot_i32, matmul128.dot_i32_mxu])
+@pytest.mark.parametrize("shape", [(5, 64, 3), (37, 253, 16), (1, 1024, 1)])
+def test_dot_exact(impl, shape):
+    bsz, k, e = shape
+    rng = np.random.default_rng(hash(shape) % 2 ** 31)
+    a = rng.integers(-2 ** 31, 2 ** 31, (bsz, k), dtype=np.int64).astype(
+        np.int32)
+    b = rng.integers(-2 ** 31, 2 ** 31, (k, e), dtype=np.int64).astype(
+        np.int32)
+    got = np.asarray(jax.jit(impl)(jnp.asarray(a), jnp.asarray(b)))
+    assert (got.astype(np.uint32) == _exact_mod32(a, b)).all()
+
+
+def test_dot_impl_switch():
+    a = jnp.ones((2, 8), jnp.int32)
+    b = jnp.ones((8, 2), jnp.int32)
+    try:
+        matmul128.set_dot_impl("mxu")
+        assert (np.asarray(matmul128.dot(a, b)) == 8).all()
+    finally:
+        matmul128.set_dot_impl("i32")
+    with pytest.raises(KeyError):
+        matmul128.set_dot_impl("nope")
+
+
+def test_prf_pair_matches_single_calls():
+    rng = np.random.default_rng(9)
+    ints = [int.from_bytes(rng.bytes(16), "little") for _ in range(9)]
+    seeds = jnp.asarray(u128.ints_to_limbs(ints))
+    for method in (0, 1, 2, 3):
+        p0, p1 = jax.jit(lambda s: prf.prf_pair(method, s))(seeds)
+        want0 = [prf_ref.prf(method, s, 0) for s in ints]
+        want1 = [prf_ref.prf(method, s, 1) for s in ints]
+        assert u128.limbs_to_ints(np.asarray(p0)) == want0, method
+        assert u128.limbs_to_ints(np.asarray(p1)) == want1, method
+
+
+def test_round_unroll_flag_bit_exact():
+    """Forced unroll must not change any PRF output."""
+    rng = np.random.default_rng(11)
+    ints = [int.from_bytes(rng.bytes(16), "little") for _ in range(5)]
+    seeds = jnp.asarray(u128.ints_to_limbs(ints))
+    old = prf.ROUND_UNROLL
+    try:
+        outs = {}
+        for flag in (False, True):
+            prf.ROUND_UNROLL = flag
+            for method in (1, 2, 3):
+                fn = jax.jit(lambda s, m=method: prf.prf_v(m, s, 1))
+                outs[(method, flag)] = np.asarray(fn(seeds))
+        for method in (1, 2, 3):
+            assert (outs[(method, False)] == outs[(method, True)]).all()
+    finally:
+        prf.ROUND_UNROLL = old
