@@ -81,7 +81,19 @@ class DPF(object):
 
     DEFAULT_PRF = PRF_AES128
 
-    def __init__(self, prf=None, strict=True):
+    def __init__(self, prf=None, strict=True, config=None):
+        """config: optional utils.config.EvalConfig consolidating the
+        runtime knobs (prf_method, batch_size, chunk_leaves, dot_impl,
+        aes_impl, round_unroll) — the replacement for the reference's
+        compile-time -D flag tiers."""
+        self._config = config
+        if config is not None:
+            if prf is None:
+                prf = config.prf_method
+            self.BATCH_SIZE = config.batch_size
+            if config.round_unroll is not None:
+                from .core import prf as _prf_mod
+                _prf_mod.ROUND_UNROLL = config.round_unroll
         self.prf_method = self.DEFAULT_PRF if prf is None else prf
         self.prf_method_string = PRF_NAMES[self.prf_method]
         self.strict = strict          # enforce reference shape limits
@@ -180,12 +192,23 @@ class DPF(object):
                     "key generated for n=%d but table has n=%d" % (fk.n, n))
         cw1, cw2, last = expand.pack_keys(flat)
         depth = n.bit_length() - 1
-        chunk = expand.choose_chunk(n, len(flat))
+        chunk = (self._config.chunk_leaves
+                 if self._config and self._config.chunk_leaves
+                 else expand.choose_chunk(n, len(flat)))
+        chunk = min(chunk, n)
+        if n % chunk:
+            raise ValueError(
+                "chunk_leaves (%d) must divide table size %d" % (chunk, n))
+        from .core import prf as _prf
         from .ops import matmul128
         out = expand.expand_and_contract(
             cw1, cw2, last, self.table_device, depth=depth,
             prf_method=self.prf_method, chunk_leaves=chunk,
-            dot_impl=matmul128.default_impl())
+            dot_impl=self._config.dot_impl if self._config else
+            matmul128.default_impl(),
+            aes_impl=(self._config.aes_impl if self._config and
+                      self._config.aes_impl != "auto" else
+                      _prf._aes_pair_impl()))
         return np.asarray(out)
 
     # ------------------------------------------------------------ eval_cpu

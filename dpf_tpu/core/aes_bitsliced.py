@@ -1,0 +1,326 @@
+"""Bitsliced AES-128 for TPU: no gathers, pure boolean ops on bit planes.
+
+The gather-based S-box (``prf.prf_aes128_jax``) makes AES the slow PRF on
+TPU — small-table gathers do not vectorize onto the VPU.  This module
+instead packs 32 AES instances per uint32 lane ("bitslicing"): the state is
+held as 8 *bit tensors* of shape ``[n_bytes, W]`` (bit i, byte position,
+word; word w bit j of a plane = that bit of instance ``32w + j'`` for a
+fixed permutation j' — harmless, every op is elementwise and the unpack
+applies the exact inverse).  Every AES step is then AND/XOR/relabel:
+
+* SubBytes: GF(2^8) inversion via the square-and-multiply chain
+  x -> x^3 -> x^15 -> x^63 -> x^127 -> x^254 (4 products + linear
+  squarings), then the affine transform — mechanically derived from the
+  field definition and verified bit-exactly against the table S-box.  One
+  S-box circuit evaluation covers BOTH states' 16 bytes and the key
+  schedule's 4 (the byte axis is just tensor width), so the per-round graph
+  is ~1K ops and the 9 uniform rounds sit in a ``fori_loop``.
+* ShiftRows: static byte-axis permutation (free).
+* MixColumns: a roll on the row axis + xtime (bit-index shift) + XORs.
+* Key schedule: computed once, shared by the two GGM child encryptions
+  (positions 0/1 differ only in plaintext byte 0, whose planes are
+  constants).
+
+Bit-transpose in/out of the sliced layout is the classic 32x32 masked
+shift-swap (5 rounds), vectorized over blocks.
+
+Semantics identical to ``prf_ref.prf_aes128`` (key = seed LE bytes,
+pt = pos LE bytes, output LE) — asserted by tests for both positions.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_MASKS = {
+    16: 0x0000FFFF,
+    8: 0x00FF00FF,
+    4: 0x0F0F0F0F,
+    2: 0x33333333,
+    1: 0x55555555,
+}
+
+
+def _transpose32(words):
+    """32x32 bit transpose (masked shift-swap), vectorized over blocks.
+
+    `words`: list of 32 arrays [W] u32.  Involution up to a fixed reversal:
+    element j bit b of the input appears at row 31-b bit 31-j.
+    """
+    x = list(words)
+    for j in (16, 8, 4, 2, 1):
+        m = np.uint32(_MASKS[j])
+        for k in range(32):
+            if k & j:
+                continue
+            t = (x[k] ^ (x[k + j] >> np.uint32(j))) & m
+            x[k] = x[k] ^ t
+            x[k + j] = x[k + j] ^ (t << np.uint32(j))
+    return x
+
+
+def pack_planes(values):
+    """[M] u32 (M % 32 == 0) -> 32 planes [M/32] u32; plane b holds bit b
+    of every element (element order within a word is permuted — see above).
+    """
+    m = values.shape[0]
+    blocks = values.reshape(m // 32, 32)
+    rows = [blocks[:, k] for k in range(32)]
+    return _transpose32(rows)[::-1]
+
+
+def unpack_planes(planes):
+    """Inverse of pack_planes: 32 planes [W] -> [32*W] u32 values."""
+    rows = _transpose32(list(planes)[::-1])
+    if isinstance(rows[0], np.ndarray):
+        blocks = np.stack(rows, axis=1)
+    else:
+        import jax.numpy as jnp
+        blocks = jnp.stack(rows, axis=1)
+    return blocks.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# GF(2^8) circuits on 8 bit-tensors (LSB-first; any common shape)
+# ---------------------------------------------------------------------------
+
+def _gf_mul(a, b):
+    """Schoolbook product reduced mod x^8 + x^4 + x^3 + x + 1."""
+    t = [None] * 15
+    for i in range(8):
+        for j in range(8):
+            p = a[i] & b[j]
+            k = i + j
+            t[k] = p if t[k] is None else t[k] ^ p
+    for d in range(14, 7, -1):  # x^d -> x^(d-4)+x^(d-5)+x^(d-7)+x^(d-8)
+        v = t[d]
+        t[d - 4] = t[d - 4] ^ v
+        t[d - 5] = t[d - 5] ^ v
+        t[d - 7] = t[d - 7] ^ v
+        t[d - 8] = t[d - 8] ^ v
+    return t[:8]
+
+
+def _sq_table():
+    rows = [[0] * 8 for _ in range(8)]
+    for i in range(8):
+        v = 1
+        for _ in range(2 * i):
+            v <<= 1
+            if v & 0x100:
+                v ^= 0x11B
+        for bit in range(8):
+            if (v >> bit) & 1:
+                rows[bit][i] = 1
+    return rows
+
+
+_SQ_ROWS = _sq_table()
+
+
+def _gf_sq(a):
+    """Squaring is GF(2)-linear: fixed XOR combination per output bit."""
+    out = []
+    for bit in range(8):
+        acc = None
+        for i in range(8):
+            if _SQ_ROWS[bit][i]:
+                acc = a[i] if acc is None else acc ^ a[i]
+        out.append(acc)
+    return out
+
+
+def _sbox_bits(a, ones):
+    """AES S-box: x^254 (= inverse, 0 -> 0) then affine (+0x63)."""
+    x2 = _gf_sq(a)
+    x3 = _gf_mul(x2, a)
+    x15 = _gf_mul(_gf_sq(_gf_sq(x3)), x3)
+    x63 = _gf_mul(_gf_sq(_gf_sq(x15)), x3)
+    x127 = _gf_mul(_gf_sq(x63), a)
+    inv = _gf_sq(x127)
+    out = []
+    for i in range(8):
+        acc = (inv[i] ^ inv[(i + 4) % 8] ^ inv[(i + 5) % 8]
+               ^ inv[(i + 6) % 8] ^ inv[(i + 7) % 8])
+        if (0x63 >> i) & 1:
+            acc = acc ^ ones
+        out.append(acc)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# AES steps.  A state is a list of 8 tensors [16, W] (bit, byte, word) with
+# byte = FIPS flat index 4*col + row.
+# ---------------------------------------------------------------------------
+
+_SHIFT_ROWS_BYTE = np.array(
+    [(4 * ((i // 4 + i % 4) % 4)) + i % 4 for i in range(16)])
+
+
+def _shift_rows(bits):
+    return [b[_SHIFT_ROWS_BYTE] for b in bits]
+
+
+def _xtime_bits(bits):
+    out = [bits[7]]
+    for i in range(1, 8):
+        v = bits[i - 1]
+        if (0x1B >> i) & 1:
+            v = v ^ bits[7]
+        out.append(v)
+    return out
+
+
+def _mix_columns(bits):
+    a4 = [b.reshape(4, 4, -1) for b in bits]          # [col, row, W]
+    if isinstance(bits[0], np.ndarray):
+        roll = np.roll
+    else:
+        import jax.numpy as jnp
+        roll = jnp.roll
+    nxt = [roll(a, -1, axis=1) for a in a4]
+    x = [a4[i] ^ nxt[i] for i in range(8)]
+    xt = _xtime_bits(x)
+    out = []
+    for i in range(8):
+        t = (a4[i][:, 0:1] ^ a4[i][:, 1:2] ^ a4[i][:, 2:3]
+             ^ a4[i][:, 3:4])
+        out.append((a4[i] ^ t ^ xt[i]).reshape(16, -1))
+    return out
+
+
+_ROT_WORD = np.array([13, 14, 15, 12])
+
+
+def _concat(parts):
+    if isinstance(parts[0], np.ndarray):
+        return np.concatenate(parts, axis=0)
+    import jax.numpy as jnp
+    return jnp.concatenate(parts, axis=0)
+
+
+def _round(st0, st1, rk, rcon_word, ones):
+    """One AES round on both states + schedule step.  `mix` outside for the
+    final round.  Fuses all 36 S-box byte positions into one circuit pass.
+    Returns (sub0, sub1, new_rk) with sub* = SubBytes(st*) (pre-ShiftRows).
+    """
+    fused_in = [_concat([st0[i], st1[i], rk[i][_ROT_WORD]])
+                for i in range(8)]
+    fused_out = _sbox_bits(fused_in, ones)
+    sub0 = [f[:16] for f in fused_out]
+    sub1 = [f[16:32] for f in fused_out]
+    t = [f[32:36] for f in fused_out]
+    # rcon into byte 0 of the rotated word
+    t = [_concat([t[i][0:1] ^ (ones * ((rcon_word >> np.uint32(i))
+                                       & np.uint32(1))),
+                  t[i][1:]]) for i in range(8)]
+    # words: out_w0 = rk_w0 ^ t; out_wk = out_w{k-1} ^ rk_wk
+    new_rk = []
+    for i in range(8):
+        r = rk[i].reshape(4, 4, -1)                   # [word, byte, W]
+        w0 = r[0] ^ t[i]
+        w1 = w0 ^ r[1]
+        w2 = w1 ^ r[2]
+        w3 = w2 ^ r[3]
+        if isinstance(w0, np.ndarray):
+            new_rk.append(np.concatenate([w0, w1, w2, w3], axis=0))
+        else:
+            import jax.numpy as jnp
+            new_rk.append(jnp.concatenate([w0, w1, w2, w3], axis=0))
+    return sub0, sub1, new_rk
+
+
+_RCON_VALS = [None, 1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36]
+_RCON_ARR = np.array([1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36],
+                     dtype=np.uint32)
+
+
+def _middle_round(st0, st1, rk, rcon_word, ones):
+    sub0, sub1, rk = _round(st0, st1, rk, rcon_word, ones)
+    st0 = _mix_columns(_shift_rows(sub0))
+    st1 = _mix_columns(_shift_rows(sub1))
+    st0 = [st0[i] ^ rk[i] for i in range(8)]
+    st1 = [st1[i] ^ rk[i] for i in range(8)]
+    return st0, st1, rk
+
+
+def aes128_pair_bitsliced(seeds):
+    """Bitsliced AES of positions 0 and 1 under per-element keys.
+
+    seeds: [..., 4] uint32 limb array (NumPy or JAX) -> (out0, out1), same
+    shape, matching ``prf_ref.prf_aes128(seed, 0/1)`` bit-exactly.  Under
+    JAX the nine uniform middle rounds run in a ``fori_loop`` (honoring
+    ``prf.ROUND_UNROLL``).
+    """
+    is_np = isinstance(seeds, np.ndarray)
+    if is_np:
+        xp = np
+    else:
+        import jax.numpy as jnp
+        xp = jnp
+
+    orig_shape = seeds.shape
+    flat = seeds.reshape(-1, 4)
+    m = flat.shape[0]
+    pad = (-m) % 32
+    if pad:
+        flat = xp.concatenate(
+            [flat, xp.zeros((pad, 4), dtype=xp.uint32)], axis=0)
+
+    # plane p (= seed bit p = LE key byte p//8, bit p%8) -> bit tensors
+    # bits[i][byte] with byte-major state order matching the key bytes
+    planes = []
+    for l in range(4):
+        planes.extend(pack_planes(flat[:, l]))
+    w = planes[0].shape[0]
+    rk = [xp.stack([planes[8 * byte + i] for byte in range(16)])
+          for i in range(8)]                          # 8 x [16, W]
+
+    zero = xp.zeros((16, w), dtype=xp.uint32)
+    ones = xp.zeros((w,), dtype=xp.uint32) + np.uint32(0xFFFFFFFF)
+
+    # plaintext 0: zero planes; plaintext 1: byte 0 bit 0 set
+    st0 = [zero ^ rk[i] for i in range(8)]
+    one_b0 = _concat([ones[None, :], zero[1:]])
+    st1 = [(one_b0 if i == 0 else zero) ^ rk[i] for i in range(8)]
+
+    if is_np:
+        for rnd in range(1, 10):
+            st0, st1, rk = _middle_round(st0, st1, rk,
+                                         np.uint32(_RCON_VALS[rnd]), ones)
+    else:
+        import jax
+        from . import prf as _prf
+        rcon_arr = xp.asarray(_RCON_ARR)
+
+        def body(r, carry):
+            a, b, c = carry
+            st0 = [a[i] for i in range(8)]
+            st1 = [b[i] for i in range(8)]
+            rkl = [c[i] for i in range(8)]
+            st0, st1, rkl = _middle_round(st0, st1, rkl, rcon_arr[r], ones)
+            return (xp.stack(st0), xp.stack(st1), xp.stack(rkl))
+
+        carry = (xp.stack(st0), xp.stack(st1), xp.stack(rk))
+        carry = jax.lax.fori_loop(0, 9, body, carry,
+                                  unroll=_prf._round_unroll())
+        st0 = [carry[0][i] for i in range(8)]
+        st1 = [carry[1][i] for i in range(8)]
+        rk = [carry[2][i] for i in range(8)]
+
+    # final round: Sub + Shift + ARK (no MixColumns)
+    sub0, sub1, rk = _round(st0, st1, rk, np.uint32(_RCON_VALS[10]), ones)
+    sh0, sh1 = _shift_rows(sub0), _shift_rows(sub1)
+    st0 = [sh0[i] ^ rk[i] for i in range(8)]
+    st1 = [sh1[i] ^ rk[i] for i in range(8)]
+
+    def to_limbs(st):
+        # st bits[i][byte] -> planes p = 8*byte + i -> limbs
+        limbs = []
+        for l in range(4):
+            pl = [st[p % 8][p // 8] for p in range(32 * l, 32 * l + 32)]
+            limbs.append(unpack_planes(pl))
+        out = xp.stack(limbs, axis=-1)[:m]
+        return out.reshape(orig_shape)
+
+    return to_limbs(st0), to_limbs(st1)

@@ -50,10 +50,11 @@ def choose_chunk(n: int, batch: int) -> int:
     return c
 
 
-def _level_step(seeds, cw1, cw2, i: int, prf_method: int):
+def _level_step(seeds, cw1, cw2, i: int, prf_method: int,
+                aes_impl: str | None = None):
     """One GGM level: [B, w, 4] -> [B, 2w, 4].  `i` is the flat level index."""
     sel = (seeds[..., 0] & np.uint32(1)).astype(bool)[..., None]  # [B, w, 1]
-    prf_out = prf_pair(prf_method, seeds)
+    prf_out = prf_pair(prf_method, seeds, aes_impl)
     children = []
     for b in (0, 1):
         cw = jnp.where(sel, cw2[:, None, 2 * i + b, :],
@@ -71,10 +72,11 @@ def permute_table(table_i32: np.ndarray) -> np.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("depth", "prf_method",
-                                             "chunk_leaves", "dot_impl"))
+                                             "chunk_leaves", "dot_impl",
+                                             "aes_impl"))
 def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
                         prf_method: int, chunk_leaves: int,
-                        dot_impl: str = "i32"):
+                        dot_impl: str = "i32", aes_impl: str | None = None):
     """Batched fused DPF evaluation.
 
     Args:
@@ -96,13 +98,15 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
     f_levels = int(np.log2(f))
     # Phase 1: root -> frontier (levels depth-1 .. depth-f_levels)
     for l in range(f_levels):
-        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method)
+        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method,
+                            aes_impl)
 
     def expand_subtree(node_seeds):
         """[B, 4] frontier seeds -> [B, C] low-32 leaf shares."""
         s = node_seeds[:, None, :]
         for l in range(f_levels, depth):
-            s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method)
+            s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method,
+                            aes_impl)
         return s[..., 0].astype(jnp.int32)  # low limb, [B, C]
 
     table_chunks = table_perm.reshape(f, c, e)
@@ -144,6 +148,36 @@ def expand_leaves(cw1, cw2, last, *, depth: int, prf_method: int):
     lo = seeds[..., 0].astype(jnp.int32)  # [B, N] BFS order
     perm = u128.bit_reverse_indices(1 << depth)
     return lo[:, perm]
+
+
+def eval_points(cw1, cw2, last, indices, *, depth: int, prf_method: int):
+    """Per-index root-to-leaf walks on device: [B,...] keys x [Q] indices.
+
+    The "naive strategy" analogue (reference ``dpf_gpu/dpf/dpf_naive.cu``):
+    O(Q log N) PRF calls per key, no auxiliary memory, natural-order output.
+    Useful for spot-checks and sparse queries.  Returns [B, Q] int32.
+    """
+    indices = jnp.asarray(indices, dtype=jnp.uint32)
+
+    def walk(cw1_k, cw2_k, last_k, idx):
+        # one key, one index
+        def level(l, carry):
+            seed, rem = carry
+            i = depth - 1 - l
+            b = (rem & np.uint32(1)).astype(jnp.int32)
+            out_pair = prf_pair(prf_method, seed[None, :])
+            val = jnp.where(b == 0, out_pair[0][0], out_pair[1][0])
+            sel = (seed[0] & np.uint32(1)).astype(bool)
+            cw_pair = jnp.where(sel, cw2_k[2 * i + b], cw1_k[2 * i + b])
+            nxt = u128.add128(val, cw_pair)
+            return nxt, rem >> np.uint32(1)
+
+        seed, _ = jax.lax.fori_loop(0, depth, level, (last_k, idx))
+        return seed[0].astype(jnp.int32)
+
+    per_key = jax.vmap(jax.vmap(walk, in_axes=(None, None, None, 0)),
+                       in_axes=(0, 0, 0, None))
+    return per_key(cw1, cw2, last, indices)
 
 
 def pack_keys(flat_keys) -> tuple:

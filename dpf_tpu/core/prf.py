@@ -470,8 +470,40 @@ def prf_aes128_pair_jax(seeds):
             _limbs_of_bytes(u128._stack_last([st1[i] for i in range(16)])))
 
 
-def prf_pair(method: int, seeds):
-    """Both children PRF(seed, 0), PRF(seed, 1) — fused where profitable."""
+AES_PAIR_IMPL = "auto"  # "auto" | "gather" | "bitsliced"
+
+
+def _aes_pair_impl() -> str:
+    """Resolved module default ("gather"/"bitsliced") — thread this into
+    jitted programs as a static argument."""
+    if AES_PAIR_IMPL != "auto":
+        return AES_PAIR_IMPL
+    return "bitsliced" if _default_backend_tpu() else "gather"
+
+
+def prf_pair(method: int, seeds, aes_impl: str | None = None):
+    """Both children PRF(seed, 0), PRF(seed, 1) — fused where profitable.
+
+    For AES the key schedule is shared between the two children; on TPU the
+    whole cipher additionally runs bitsliced (no gathers) — see
+    ``aes_bitsliced.py``.  All variants are bit-identical.  ``aes_impl``
+    must be threaded from a jit *static* argument by callers inside jit
+    (module default otherwise) so switching implementations retraces.
+    """
     if not isinstance(seeds, np.ndarray) and method == PRF_AES128:
+        impl = aes_impl or AES_PAIR_IMPL
+        if impl == "auto":
+            impl = "bitsliced" if _default_backend_tpu() else "gather"
+        if impl == "bitsliced":
+            from .aes_bitsliced import aes128_pair_bitsliced
+            return aes128_pair_bitsliced(seeds)
         return prf_aes128_pair_jax(seeds)
     return prf_v(method, seeds, 0), prf_v(method, seeds, 1)
+
+
+def _default_backend_tpu() -> bool:
+    try:
+        import jax
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False

@@ -48,10 +48,11 @@ class RecDataset:
 
 
 def make_rec_dataset(n_items=2000, n_users=400, samples_per_user=6,
-                     max_hist=10, n_clusters=20, seed=0) -> RecDataset:
+                     max_hist=10, n_clusters=20, seed=0,
+                     pop_exponent=0.8) -> RecDataset:
     rng = np.random.default_rng(seed)
     # zipf popularity over items, each item assigned an interest cluster
-    pop = 1.0 / np.arange(1, n_items + 1) ** 0.8
+    pop = 1.0 / np.arange(1, n_items + 1) ** pop_exponent
     pop /= pop.sum()
     item_cluster = rng.integers(0, n_clusters, n_items)
 
@@ -90,6 +91,20 @@ def make_rec_dataset(n_items=2000, n_users=400, samples_per_user=6,
     return RecDataset(n_items=n_items, max_hist=max_hist, hist=hist,
                       hist_len=hist_len, target=target, label=label,
                       train_idx=perm[:split], val_idx=perm[split:])
+
+
+def make_ratings_dataset(n_items=1500, n_users=300, samples_per_user=8,
+                         max_hist=16, n_clusters=12, seed=1) -> RecDataset:
+    """MovieLens-style second recommendation workload (reference
+    ``modules/movielens_rec/movielens_dataset.py``): same contract as
+    ``make_rec_dataset`` but longer histories, flatter popularity, and
+    denser per-user activity — a different access-pattern regime for the
+    batch-PIR sweeps (bins see more co-access, hot split matters less).
+    """
+    return make_rec_dataset(n_items=n_items, n_users=n_users,
+                            samples_per_user=samples_per_user,
+                            max_hist=max_hist, n_clusters=n_clusters,
+                            seed=seed, pop_exponent=0.4)
 
 
 @dataclass

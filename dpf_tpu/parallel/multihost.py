@@ -1,0 +1,62 @@
+"""Multi-host scale-out: jax.distributed init + global-mesh construction.
+
+The reference has no multi-node path at all (SURVEY.md §2.4); the TPU
+equivalent of a NCCL/MPI backend is ``jax.distributed`` over DCN for
+process coordination with XLA collectives over ICI inside each slice.  This
+module wraps the standard recipe so a multi-host DPF server is:
+
+    multihost.initialize()                       # once per process
+    mesh = multihost.global_mesh(n_batch=2)      # ("batch", "table")
+    srv = sharded.ShardedDPFServer(table, mesh)  # same code as single host
+
+Laying the "table" axis innermost keeps the psum share-reduction on
+ICI-adjacent devices; the "batch" axis (independent queries) tolerates DCN.
+On a single host these helpers degrade to the local device set, so the same
+program runs everywhere (tests exercise exactly that path).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def initialize(coordinator_address: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None):
+    """Initialize jax.distributed for multi-process runs.
+
+    With explicit arguments, failures propagate.  With no arguments,
+    initialization is attempted unconditionally — on TPU pod slices JAX's
+    cluster auto-detection supplies everything — and a detection failure
+    (plain single-process run, tests) degrades to a no-op returning False.
+    """
+    import jax
+    try:
+        jax.distributed.initialize(coordinator_address=coordinator_address,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+        return True
+    except Exception:
+        if (coordinator_address is not None or num_processes is not None
+                or process_id is not None):
+            raise
+        return False  # no cluster detected: single-process run
+
+
+def global_mesh(n_batch: int = 1, n_table: int | None = None):
+    """("batch", "table") mesh over ALL processes' devices.
+
+    The "table" (psum) axis is laid out over the trailing device dimension
+    — ICI-contiguous on TPU slices; "batch" spans hosts/DCN.
+    """
+    import jax
+    from ..parallel import sharded
+    devices = np.asarray(jax.devices())  # global across processes
+    return sharded.make_mesh(n_table=n_table, n_batch=n_batch,
+                             devices=devices)
+
+
+def process_info():
+    """(process_index, process_count) — for logging/sharded IO."""
+    import jax
+    return jax.process_index(), jax.process_count()

@@ -58,9 +58,9 @@ def shard_table(table_i32: np.ndarray, mesh: Mesh):
 
 @functools.partial(jax.jit,
                    static_argnames=("depth", "prf_method", "chunk_leaves",
-                                    "mesh"))
+                                    "mesh", "aes_impl"))
 def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
-                 chunk_leaves: int, mesh: Mesh):
+                 chunk_leaves: int, mesh: Mesh, aes_impl: str | None = None):
     """Mesh-parallel fused DPF evaluation.
 
     Inputs as in ``expand.expand_and_contract``; ``table_perm`` must be
@@ -79,7 +79,7 @@ def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
                                shard_ix * shard_rows,
                                depth=depth, prf_method=prf_method,
                                chunk_leaves=min(chunk_leaves, shard_rows),
-                               n_total=n)
+                               n_total=n, aes_impl=aes_impl)
         return jax.lax.psum(out, "table")
 
     fn = jax.shard_map(
@@ -90,7 +90,8 @@ def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
 
 
 def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
-                     prf_method: int, chunk_leaves: int, n_total: int):
+                     prf_method: int, chunk_leaves: int, n_total: int,
+                     aes_impl: str | None = None):
     """Expand only BFS leaves [row0, row0 + tbl.rows) and contract locally.
 
     Phase 1 walks root -> this shard's frontier; because the shard is a
@@ -108,7 +109,8 @@ def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
 
     seeds = last[:, None, :]
     for l in range(f_levels):
-        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method)
+        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method,
+                            aes_impl)
     # take the local frontier window [row0/c, row0/c + f_local)
     node0 = row0 // c
     seeds = jax.lax.dynamic_slice_in_dim(seeds, node0, f_local, axis=1)
@@ -116,7 +118,8 @@ def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
     def expand_subtree(node_seeds):
         s = node_seeds[:, None, :]
         for l in range(f_levels, depth):
-            s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method)
+            s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method,
+                            aes_impl)
         return s[..., 0].astype(jnp.int32)
 
     tbl_chunks = tbl.reshape(f_local, c, e)
@@ -171,7 +174,9 @@ class ShardedDPFServer:
         pad = (-eff) % max(nb, 1)
         flat = flat + [flat[-1]] * pad
         cw1, cw2, last = expand.pack_keys(flat)
+        from ..core import prf as _prf
         out = eval_sharded(cw1, cw2, last, self.table_sharded,
                            depth=self.depth, prf_method=self.prf_method,
-                           chunk_leaves=self.chunk, mesh=self.mesh)
+                           chunk_leaves=self.chunk, mesh=self.mesh,
+                           aes_impl=_prf._aes_pair_impl())
         return np.asarray(out)[:eff]

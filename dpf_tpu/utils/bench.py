@@ -54,3 +54,34 @@ def test_dpf_perf(N=16384, batch=512, entrysize=16, prf=None, reps=10,
               % (dpf, result["key_size_bytes"], result["dpfs_per_sec"]))
         print(json.dumps(result))
     return result
+
+
+def test_matmul_perf(B=512, K=65536, E=16, reps=10, quiet=False):
+    """Benchmark the contraction strategies alone (role of the reference's
+    ``dpf_gpu/matmul_benchmark.cu``): [B,K] x [K,E] exact mod-2^32."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import matmul128
+
+    rng = np.random.default_rng(0)
+    a = jnp.asarray(rng.integers(-2 ** 31, 2 ** 31, (B, K),
+                                 dtype=np.int64).astype(np.int32))
+    b = jnp.asarray(rng.integers(-2 ** 31, 2 ** 31, (K, E),
+                                 dtype=np.int64).astype(np.int32))
+    results = {}
+    for name, impl in matmul128.IMPLS.items():
+        fn = jax.jit(impl)
+        fn(a, b).block_until_ready()
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn(a, b)
+        out.block_until_ready()
+        elapsed = time.time() - t0
+        r = {"impl": name, "B": B, "K": K, "E": E, "reps": reps,
+             "elapsed_s": round(elapsed, 4),
+             "gops_per_sec": round(2e-9 * B * K * E * reps / elapsed, 2)}
+        results[name] = r
+        if not quiet:
+            print(json.dumps(r))
+    return results

@@ -1,0 +1,31 @@
+"""Runtime evaluation config (replaces the reference's compile-time flag
+tiers — SURVEY.md §5: ``DPF_STRATEGY``/``PRF_METHOD``/``Z``/``BATCH_SIZE``
+``-D`` flags become one dataclass; jit specializes per value).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+
+@dataclass(frozen=True)
+class EvalConfig:
+    """Everything that selects a compiled evaluation program."""
+    prf_method: int = 3            # PRF_AES128
+    batch_size: int = 512          # device dispatch cap (reference parity)
+    chunk_leaves: int | None = None  # None = auto (choose_chunk)
+    dot_impl: str = "i32"          # "i32" | "mxu" (ops/matmul128)
+    round_unroll: bool | None = None  # None = auto (unroll on TPU)
+    aes_impl: str = "auto"         # "auto" | "gather" | "bitsliced"
+
+    def with_(self, **kw) -> "EvalConfig":
+        return replace(self, **kw)
+
+    def apply_globals(self):
+        """Push the process-wide knobs (round_unroll, aes/dot defaults)."""
+        from ..core import prf
+        from ..ops import matmul128
+        prf.ROUND_UNROLL = self.round_unroll
+        prf.AES_PAIR_IMPL = self.aes_impl
+        matmul128.set_dot_impl(self.dot_impl)
+        return self

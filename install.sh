@@ -1,0 +1,3 @@
+#!/bin/sh
+# Install the package (the native host library self-builds on first import).
+python3 -m pip install .

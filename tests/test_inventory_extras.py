@@ -1,0 +1,131 @@
+"""Tests for the inventory-completing pieces: naive per-index device path,
+PRF zoo, matmul benchmark, runtime config, multihost helpers, second rec
+dataset."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import expand, keygen, prf_ref, prf_zoo, u128
+
+
+def test_eval_points_matches_flat_eval():
+    n, depth, method = 256, 8, 1
+    flat = [keygen.generate_keys((37 * i) % n, n, b"np%d" % i, method)[0]
+            for i in range(3)]
+    cw1, cw2, last = expand.pack_keys(flat)
+    idx = np.array([0, 1, 37, 74, 255], dtype=np.uint32)
+    got = np.asarray(expand.eval_points(cw1, cw2, last, idx, depth=depth,
+                                        prf_method=method))
+    for b, fk in enumerate(flat):
+        for q, i in enumerate(idx):
+            want = keygen.evaluate_flat(fk, int(i), method) & 0xFFFFFFFF
+            assert got[b, q].astype(np.uint32) == want
+
+
+def test_eval_points_share_recovery():
+    n, alpha, method = 512, 300, 0
+    k0, k1 = keygen.generate_keys(alpha, n, b"pt", method)
+    idx = np.array([alpha - 1, alpha, alpha + 1], dtype=np.uint32)
+    outs = []
+    for k in (k0, k1):
+        cw1, cw2, last = expand.pack_keys([k])
+        outs.append(np.asarray(expand.eval_points(
+            cw1, cw2, last, idx, depth=9, prf_method=method)))
+    d = (outs[0].view(np.uint32) - outs[1].view(np.uint32))[0]
+    assert list(d) == [0, 1, 0]
+
+
+def test_prf_zoo_round_variants():
+    import jax.numpy as jnp
+    ints = [12345678901234567890123456789012345]
+    seeds = jnp.asarray(u128.ints_to_limbs(ints))
+    # 12-round variants must agree with the wire PRFs
+    got = u128.limbs_to_ints(np.asarray(prf_zoo.ZOO["salsa20_12"](seeds, 1)))
+    assert got == [prf_ref.prf_salsa20_12(ints[0], 1)]
+    got = u128.limbs_to_ints(np.asarray(prf_zoo.ZOO["chacha12"](seeds, 1)))
+    assert got == [prf_ref.prf_chacha20_12(ints[0], 1)]
+    # other round counts must differ (they are different ciphers)
+    v8 = u128.limbs_to_ints(np.asarray(prf_zoo.ZOO["salsa20_8"](seeds, 1)))
+    v20 = u128.limbs_to_ints(np.asarray(prf_zoo.ZOO["salsa20_20"](seeds, 1)))
+    assert v8 != got and v20 != got and v8 != v20
+
+
+def test_zoo_benchmark_runs():
+    r = prf_zoo.benchmark_zoo(n_calls=1 << 10, reps=1,
+                              names=["salsa20_8", "chacha12"])
+    assert set(r) == {"salsa20_8", "chacha12"}
+    assert all(v > 0 for v in r.values())
+
+
+def test_matmul_benchmark_runs():
+    from dpf_tpu.utils.bench import test_matmul_perf
+    r = test_matmul_perf(B=8, K=256, E=4, reps=1, quiet=True)
+    assert set(r) == {"i32", "mxu"}
+    assert all(x["gops_per_sec"] > 0 for x in r.values())
+
+
+def test_eval_config():
+    from dpf_tpu.core import prf
+    from dpf_tpu.ops import matmul128
+    from dpf_tpu.utils.config import EvalConfig
+    old_unroll, old_impl = prf.ROUND_UNROLL, matmul128.default_impl()
+    try:
+        cfg = EvalConfig().with_(dot_impl="mxu", round_unroll=True)
+        cfg.apply_globals()
+        assert matmul128.default_impl() == "mxu"
+        assert prf.ROUND_UNROLL is True
+    finally:
+        prf.ROUND_UNROLL = old_unroll
+        matmul128.set_dot_impl(old_impl)
+
+
+def test_eval_config_drives_dpf():
+    """Every EvalConfig field must be consumed by DPF, not decorative."""
+    from dpf_tpu import DPF
+    from dpf_tpu.core import prf
+    from dpf_tpu.utils.config import EvalConfig
+    old_unroll = prf.ROUND_UNROLL
+    try:
+        cfg = EvalConfig(prf_method=DPF.PRF_SALSA20, batch_size=4,
+                         chunk_leaves=64, dot_impl="mxu",
+                         aes_impl="gather", round_unroll=False)
+        d = DPF(config=cfg)
+        assert d.prf_method == DPF.PRF_SALSA20   # prf from config
+        assert d.BATCH_SIZE == 4                 # dispatch cap from config
+        assert prf.ROUND_UNROLL is False         # pushed at init
+        n = 128
+        table = np.random.randint(0, 2 ** 31, (n, 3),
+                                  dtype=np.int64).astype(np.int32)
+        d.eval_init(table)
+        idx = 77
+        k1, k2 = d.gen(idx, n)
+        # batch of 5 exceeds batch_size 4 -> two dispatches; chunk_leaves=64
+        # divides n -> used; dot_impl/aes_impl threaded as static args
+        rec = (np.asarray(d.eval_tpu([k1] * 5))
+               - np.asarray(d.eval_tpu([k2] * 5))).astype(np.int32)
+        assert (rec == table[idx]).all()
+        # invalid chunk (does not divide n) must be rejected
+        bad = DPF(config=cfg.with_(chunk_leaves=48))
+        bad.eval_init(table)
+        with pytest.raises(ValueError):
+            bad.eval_tpu([k1])
+    finally:
+        prf.ROUND_UNROLL = old_unroll
+
+
+def test_multihost_single_process():
+    from dpf_tpu.parallel import multihost
+    assert multihost.initialize() is False  # no coordinator -> local no-op
+    mesh = multihost.global_mesh(n_batch=2)
+    assert mesh.shape["batch"] == 2
+    pi, pc = multihost.process_info()
+    assert pi == 0 and pc == 1
+
+
+def test_ratings_dataset_contract():
+    from dpf_tpu.models import datasets
+    ds = datasets.make_ratings_dataset(n_items=200, n_users=30,
+                                       samples_per_user=3)
+    pats = ds.access_patterns("train")
+    assert len(pats) > 0 and all(len(p) >= 3 for p in pats)
+    assert max(max(p) for p in pats) < 200
