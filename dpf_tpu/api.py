@@ -91,9 +91,6 @@ class DPF(object):
             if prf is None:
                 prf = config.prf_method
             self.BATCH_SIZE = config.batch_size
-            if config.round_unroll is not None:
-                from .core import prf as _prf_mod
-                _prf_mod.ROUND_UNROLL = config.round_unroll
         self.prf_method = self.DEFAULT_PRF if prf is None else prf
         self.prf_method_string = PRF_NAMES[self.prf_method]
         self.strict = strict          # enforce reference shape limits
@@ -208,7 +205,9 @@ class DPF(object):
             matmul128.default_impl(),
             aes_impl=(self._config.aes_impl if self._config and
                       self._config.aes_impl != "auto" else
-                      _prf._aes_pair_impl()))
+                      _prf._aes_pair_impl()),
+            round_unroll=(self._config.round_unroll if self._config
+                          else _prf.ROUND_UNROLL))
         return np.asarray(out)
 
     # ------------------------------------------------------------ eval_cpu

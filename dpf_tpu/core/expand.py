@@ -73,10 +73,11 @@ def permute_table(table_i32: np.ndarray) -> np.ndarray:
 
 @functools.partial(jax.jit, static_argnames=("depth", "prf_method",
                                              "chunk_leaves", "dot_impl",
-                                             "aes_impl"))
+                                             "aes_impl", "round_unroll"))
 def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
                         prf_method: int, chunk_leaves: int,
-                        dot_impl: str = "i32", aes_impl: str | None = None):
+                        dot_impl: str = "i32", aes_impl: str | None = None,
+                        round_unroll: bool | None = None):
     """Batched fused DPF evaluation.
 
     Args:
@@ -94,37 +95,47 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
     f = n // c  # frontier width
     assert c * f == n and depth == int(np.log2(n))
 
-    seeds = last[:, None, :]  # [B, 1, 4]
-    f_levels = int(np.log2(f))
-    # Phase 1: root -> frontier (levels depth-1 .. depth-f_levels)
-    for l in range(f_levels):
-        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method,
-                            aes_impl)
+    # round_unroll is a static cache key; scope the module knob the PRF
+    # round loops read to this trace (restored after) so switching the
+    # setting retraces cleanly and never leaks across instances
+    from . import prf as _prf_mod
+    saved_unroll = _prf_mod.ROUND_UNROLL
+    if round_unroll is not None:
+        _prf_mod.ROUND_UNROLL = round_unroll
+    try:
+        seeds = last[:, None, :]  # [B, 1, 4]
+        f_levels = int(np.log2(f))
+        # Phase 1: root -> frontier (levels depth-1 .. depth-f_levels)
+        for l in range(f_levels):
+            seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method,
+                                aes_impl)
 
-    def expand_subtree(node_seeds):
-        """[B, 4] frontier seeds -> [B, C] low-32 leaf shares."""
-        s = node_seeds[:, None, :]
-        for l in range(f_levels, depth):
-            s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method,
-                            aes_impl)
-        return s[..., 0].astype(jnp.int32)  # low limb, [B, C]
+        def expand_subtree(node_seeds):
+            """[B, 4] frontier seeds -> [B, C] low-32 leaf shares."""
+            s = node_seeds[:, None, :]
+            for l in range(f_levels, depth):
+                s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method,
+                                aes_impl)
+            return s[..., 0].astype(jnp.int32)  # low limb, [B, C]
 
-    table_chunks = table_perm.reshape(f, c, e)
+        table_chunks = table_perm.reshape(f, c, e)
 
-    if f == 1:
-        leaves = expand_subtree(seeds[:, 0, :])
-        return _dot_i32(leaves, table_chunks[0], dot_impl)
+        if f == 1:
+            leaves = expand_subtree(seeds[:, 0, :])
+            return _dot_i32(leaves, table_chunks[0], dot_impl)
 
-    frontier = jnp.moveaxis(seeds, 1, 0)  # [F, B, 4]
+        frontier = jnp.moveaxis(seeds, 1, 0)  # [F, B, 4]
 
-    def body(acc, xs):
-        node_seeds, chunk = xs
-        leaves = expand_subtree(node_seeds)         # [B, C] int32
-        return acc + _dot_i32(leaves, chunk, dot_impl), None
+        def body(acc, xs):
+            node_seeds, chunk = xs
+            leaves = expand_subtree(node_seeds)         # [B, C] int32
+            return acc + _dot_i32(leaves, chunk, dot_impl), None
 
-    acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
-    acc, _ = lax.scan(body, acc0, (frontier, table_chunks))
-    return acc
+        acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
+        acc, _ = lax.scan(body, acc0, (frontier, table_chunks))
+        return acc
+    finally:
+        _prf_mod.ROUND_UNROLL = saved_unroll
 
 
 def _dot_i32(a, b, impl: str | None = None):
@@ -150,12 +161,15 @@ def expand_leaves(cw1, cw2, last, *, depth: int, prf_method: int):
     return lo[:, perm]
 
 
-def eval_points(cw1, cw2, last, indices, *, depth: int, prf_method: int):
+def eval_points(cw1, cw2, last, indices, *, depth: int, prf_method: int,
+                aes_impl: str = "gather"):
     """Per-index root-to-leaf walks on device: [B,...] keys x [Q] indices.
 
     The "naive strategy" analogue (reference ``dpf_gpu/dpf/dpf_naive.cu``):
     O(Q log N) PRF calls per key, no auxiliary memory, natural-order output.
     Useful for spot-checks and sparse queries.  Returns [B, Q] int32.
+    ``aes_impl`` defaults to the gather S-box: these are scalar walks and
+    bitslicing would pad every single-seed PRF call to 32 lanes.
     """
     indices = jnp.asarray(indices, dtype=jnp.uint32)
 
@@ -165,7 +179,7 @@ def eval_points(cw1, cw2, last, indices, *, depth: int, prf_method: int):
             seed, rem = carry
             i = depth - 1 - l
             b = (rem & np.uint32(1)).astype(jnp.int32)
-            out_pair = prf_pair(prf_method, seed[None, :])
+            out_pair = prf_pair(prf_method, seed[None, :], aes_impl)
             val = jnp.where(b == 0, out_pair[0][0], out_pair[1][0])
             sel = (seed[0] & np.uint32(1)).astype(bool)
             cw_pair = jnp.where(sel, cw2_k[2 * i + b], cw1_k[2 * i + b])

@@ -491,9 +491,8 @@ def prf_pair(method: int, seeds, aes_impl: str | None = None):
     (module default otherwise) so switching implementations retraces.
     """
     if not isinstance(seeds, np.ndarray) and method == PRF_AES128:
-        impl = aes_impl or AES_PAIR_IMPL
-        if impl == "auto":
-            impl = "bitsliced" if _default_backend_tpu() else "gather"
+        impl = (aes_impl if aes_impl not in (None, "auto")
+                else _aes_pair_impl())
         if impl == "bitsliced":
             from .aes_bitsliced import aes128_pair_bitsliced
             return aes128_pair_bitsliced(seeds)

@@ -38,9 +38,20 @@ def initialize(coordinator_address: str | None = None,
         return True
     except Exception:
         if (coordinator_address is not None or num_processes is not None
-                or process_id is not None):
-            raise
+                or process_id is not None or _cluster_expected()):
+            raise  # a real cluster failed to initialize: surface it
         return False  # no cluster detected: single-process run
+
+
+def _cluster_expected() -> bool:
+    """Heuristic: does the environment look multi-process?  Used to decide
+    whether an auto-detect initialization failure is a real error."""
+    import os
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or \
+            os.environ.get("COORDINATOR_ADDRESS"):
+        return True
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    return "," in hosts  # more than one worker host
 
 
 def global_mesh(n_batch: int = 1, n_table: int | None = None):

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""End-to-end codesign experiment: the paper pipeline as one artifact.
+
+Reproduces the reference's experimental flow (SURVEY.md §2.2 #23-#28)
+against this framework's TPU backend:
+
+  1. build a workload (synthetic rec or lm) and train its model
+  2. sweep batch-PIR configs over the workload's access patterns
+  3. (optionally) evaluate downstream model accuracy per config
+  4. measure (or load) DPF eval throughput on the current backend
+  5. join into latency-vs-recovery/accuracy frontier points + figures
+
+  python experiments/run_codesign.py --workload rec --out /tmp/codesign \
+      [--quick] [--with-accuracy] [--perf-from sweep_logs/*.log]
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--workload", choices=["rec", "ratings", "lm"],
+                    default="rec")
+    ap.add_argument("--out", default="codesign_out")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--with-accuracy", action="store_true")
+    ap.add_argument("--perf-from", default=None,
+                    help="glob of benchmark logs; measures live if absent")
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    import dpf_tpu
+    from dpf_tpu.apps import codesign, plots, sweep
+    from dpf_tpu.models import datasets
+    from dpf_tpu.utils import scrape
+    from dpf_tpu.utils.bench import test_dpf_perf
+
+    # ---- 1. workload ----------------------------------------------------
+    if args.workload in ("rec", "ratings"):
+        make = (datasets.make_rec_dataset if args.workload == "rec"
+                else datasets.make_ratings_dataset)
+        ds = make(n_items=300 if args.quick else 2000,
+                  n_users=60 if args.quick else 400)
+        from dpf_tpu.models import rec as model_mod
+        model, params = model_mod.train_rec_model(
+            ds, epochs=2 if args.quick else 4)
+
+        def accuracy_eval(opt):
+            return model_mod.evaluate_with_pir(model, params, ds, opt)
+    else:
+        ds = datasets.make_lm_dataset(
+            vocab_size=200 if args.quick else 1000,
+            n_train=80 if args.quick else 300,
+            n_val=10 if args.quick else 60)
+        from dpf_tpu.models import lm as model_mod
+        model, params = model_mod.train_lm(ds, epochs=1 if args.quick else 3)
+
+        def accuracy_eval(opt):
+            return model_mod.evaluate_with_pir(model, params, ds, opt)
+
+    train_p = ds.access_patterns("train")
+    val_p = ds.access_patterns("val")
+
+    # ---- 2./3. batch-PIR config sweep ----------------------------------
+    grid = None
+    if args.quick:
+        grid = {"cache_size_fraction": [0.5, 1.0], "num_collocate": [0],
+                "bin_fraction": [0.1, 0.3], "queries_to_hot": [1, 2],
+                "queries_to_cold": [0]}
+    sweep_results = sweep.run_sweep(
+        train_p, val_p, out_dir=os.path.join(args.out, "sweep"), grid=grid,
+        eval_limit=50 if args.quick else None,
+        model_eval=accuracy_eval if args.with_accuracy else None)
+
+    # ---- 4. kernel perf -------------------------------------------------
+    if args.perf_from:
+        perf = [d for _, d in scrape.scrape_dir(args.perf_from)]
+    else:
+        sizes = [1024, 4096] if args.quick else [16384, 65536, 262144]
+        perf = [test_dpf_perf(N=n, batch=64 if args.quick else 512,
+                              prf=dpf_tpu.PRF_SALSA20,
+                              reps=2 if args.quick else 5, quiet=True)
+                for n in sizes]
+    with open(os.path.join(args.out, "perf.json"), "w") as f:
+        json.dump(perf, f, indent=1)
+
+    # ---- 5. join + figures ---------------------------------------------
+    points = codesign.join_sweep_with_perf(sweep_results, perf)
+    frontier = codesign.pareto_frontier(points)
+    with open(os.path.join(args.out, "frontier.json"), "w") as f:
+        json.dump({"points": points, "frontier": frontier}, f, indent=1,
+                  default=float)
+    try:
+        plots.plot_recovery_vs_queries(
+            sweep_results, os.path.join(args.out, "recovery.png"))
+        plots.plot_latency_vs_recovery(
+            points, os.path.join(args.out, "frontier.png"),
+            frontier=frontier)
+        plots.plot_throughput_table(
+            perf, os.path.join(args.out, "throughput.png"))
+    except RuntimeError:
+        pass  # matplotlib unavailable
+
+    best = frontier[-1] if frontier else None
+    print(json.dumps({
+        "workload": args.workload,
+        "configs_swept": len(sweep_results),
+        "frontier_points": len(frontier),
+        "best_recovery": best and best["mean_recovered"],
+        "best_latency_ms": best and best["latency_ms"],
+        "out": args.out,
+    }, default=float))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""Kernel-shape sweep (role of the reference's ``sweep.sh``): entries x
+batch x PRF grid, one log file per config, scrapeable into CSV.
+
+  python scripts/sweep.py [--out DIR] [--quick]
+
+Each run appends its printed-dict line to ``DIR/<config>.log``; rerunning
+skips configs whose log already has a result (resumable, like the
+reference's one-file-per-config protocol).
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="sweep_logs")
+    ap.add_argument("--quick", action="store_true",
+                    help="small grid for smoke testing")
+    ap.add_argument("--csv", default=None)
+    args = ap.parse_args()
+
+    import json
+
+    import dpf_tpu
+    from dpf_tpu.utils import scrape
+    from dpf_tpu.utils.bench import test_dpf_perf
+
+    if args.quick:
+        entries = [1024, 4096]
+        batches = [8, 32]
+        prfs = [dpf_tpu.PRF_SALSA20]
+        reps = 2
+    else:
+        entries = [1 << k for k in range(13, 21)]
+        batches = [8, 64, 512, 4096]
+        prfs = [dpf_tpu.PRF_AES128, dpf_tpu.PRF_SALSA20,
+                dpf_tpu.PRF_CHACHA20]
+        reps = 5
+
+    os.makedirs(args.out, exist_ok=True)
+    for n in entries:
+        for batch in batches:
+            for prf in prfs:
+                name = "entries=%d_batch=%d_prf=%d" % (n, batch, prf)
+                path = os.path.join(args.out, name + ".log")
+                if os.path.exists(path) and scrape.scrape_file(path):
+                    continue
+                r = test_dpf_perf(N=n, batch=batch, prf=prf, reps=reps,
+                                  quiet=True)
+                with open(path, "a") as f:
+                    f.write(json.dumps(r) + "\n")
+                print("%s -> %d dpfs/sec" % (name, r["dpfs_per_sec"]))
+
+    rows = scrape.scrape_dir(os.path.join(args.out, "*.log"))
+    csv_path = args.csv or os.path.join(args.out, "sweep.csv")
+    scrape.to_csv(rows, csv_path)
+    print("wrote %s (%d rows)" % (csv_path, len(rows)))
+
+
+if __name__ == "__main__":
+    main()

@@ -92,7 +92,8 @@ def test_eval_config_drives_dpf():
         d = DPF(config=cfg)
         assert d.prf_method == DPF.PRF_SALSA20   # prf from config
         assert d.BATCH_SIZE == 4                 # dispatch cap from config
-        assert prf.ROUND_UNROLL is False         # pushed at init
+        # round_unroll is threaded per-trace (static arg), never a global
+        assert prf.ROUND_UNROLL is old_unroll
         n = 128
         table = np.random.randint(0, 2 ** 31, (n, 3),
                                   dtype=np.int64).astype(np.int32)
