@@ -8,13 +8,15 @@ the reference's V100 number for this config is 15,392 dpfs/sec
 """
 
 import json
+import os
 import sys
+import threading
 
 BASELINE_V100_AES128_65536 = 15392.0
+WATCHDOG_S = int(os.environ.get("DPF_BENCH_WATCHDOG_S", "1500"))
 
 
-def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+def _run(n):
     import dpf_tpu
     from dpf_tpu.utils.bench import test_dpf_perf
 
@@ -27,7 +29,47 @@ def main():
         "unit": "dpfs/sec",
         "vs_baseline": round(r["dpfs_per_sec"] / BASELINE_V100_AES128_65536,
                              4),
-    }))
+    }), flush=True)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
+    # The TPU relay in this environment can wedge (any first compile hangs
+    # forever); a watchdog turns that into a diagnosable line instead of a
+    # silent hang.  Worker failures are re-reported as an error line +
+    # non-zero exit, never a silent success.
+    failure = []
+
+    def run_guarded():
+        try:
+            _run(n)
+        except BaseException as e:  # noqa: BLE001 — reported below
+            failure.append(e)
+
+    worker = threading.Thread(target=run_guarded, daemon=True)
+    worker.start()
+    worker.join(WATCHDOG_S)
+    if failure:
+        print(json.dumps({
+            "metric": "dpfs/sec (entries=%d)" % n,
+            "value": 0,
+            "unit": "dpfs/sec",
+            "vs_baseline": 0.0,
+            "error": "%s: %s" % (type(failure[0]).__name__,
+                                 str(failure[0])[:300]),
+        }), flush=True)
+        os._exit(3)
+    if worker.is_alive():
+        print(json.dumps({
+            "metric": "dpfs/sec (entries=%d, entry_size=16, AES128, "
+                      "batch=512, 1 chip)" % n,
+            "value": 0,
+            "unit": "dpfs/sec",
+            "vs_baseline": 0.0,
+            "error": "TPU backend unresponsive after %ds (axon relay "
+                     "wedged?)" % WATCHDOG_S,
+        }), flush=True)
+        os._exit(2)
 
 
 if __name__ == "__main__":

@@ -206,7 +206,9 @@ class DPF(object):
             aes_impl=(self._config.aes_impl if self._config and
                       self._config.aes_impl != "auto" else
                       _prf._aes_pair_impl()),
-            round_unroll=(self._config.round_unroll if self._config
+            round_unroll=(self._config.round_unroll
+                          if self._config and
+                          self._config.round_unroll is not None
                           else _prf.ROUND_UNROLL))
         return np.asarray(out)
 

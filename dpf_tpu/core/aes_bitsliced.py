@@ -244,13 +244,13 @@ def _middle_round(st0, st1, rk, rcon_word, ones):
     return st0, st1, rk
 
 
-def aes128_pair_bitsliced(seeds):
+def aes128_pair_bitsliced(seeds, unroll: bool | None = None):
     """Bitsliced AES of positions 0 and 1 under per-element keys.
 
     seeds: [..., 4] uint32 limb array (NumPy or JAX) -> (out0, out1), same
     shape, matching ``prf_ref.prf_aes128(seed, 0/1)`` bit-exactly.  Under
     JAX the nine uniform middle rounds run in a ``fori_loop`` (honoring
-    ``prf.ROUND_UNROLL``).
+    ``unroll``, default = prf.ROUND_UNROLL auto).
     """
     is_np = isinstance(seeds, np.ndarray)
     if is_np:
@@ -303,7 +303,8 @@ def aes128_pair_bitsliced(seeds):
 
         carry = (xp.stack(st0), xp.stack(st1), xp.stack(rk))
         carry = jax.lax.fori_loop(0, 9, body, carry,
-                                  unroll=_prf._round_unroll())
+                                  unroll=_prf._round_unroll()
+                                  if unroll is None else unroll)
         st0 = [carry[0][i] for i in range(8)]
         st1 = [carry[1][i] for i in range(8)]
         rk = [carry[2][i] for i in range(8)]

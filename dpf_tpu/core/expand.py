@@ -51,10 +51,11 @@ def choose_chunk(n: int, batch: int) -> int:
 
 
 def _level_step(seeds, cw1, cw2, i: int, prf_method: int,
-                aes_impl: str | None = None):
+                aes_impl: str | None = None,
+                round_unroll: bool | None = None):
     """One GGM level: [B, w, 4] -> [B, 2w, 4].  `i` is the flat level index."""
     sel = (seeds[..., 0] & np.uint32(1)).astype(bool)[..., None]  # [B, w, 1]
-    prf_out = prf_pair(prf_method, seeds, aes_impl)
+    prf_out = prf_pair(prf_method, seeds, aes_impl, round_unroll)
     children = []
     for b in (0, 1):
         cw = jnp.where(sel, cw2[:, None, 2 * i + b, :],
@@ -95,47 +96,37 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
     f = n // c  # frontier width
     assert c * f == n and depth == int(np.log2(n))
 
-    # round_unroll is a static cache key; scope the module knob the PRF
-    # round loops read to this trace (restored after) so switching the
-    # setting retraces cleanly and never leaks across instances
-    from . import prf as _prf_mod
-    saved_unroll = _prf_mod.ROUND_UNROLL
-    if round_unroll is not None:
-        _prf_mod.ROUND_UNROLL = round_unroll
-    try:
-        seeds = last[:, None, :]  # [B, 1, 4]
-        f_levels = int(np.log2(f))
-        # Phase 1: root -> frontier (levels depth-1 .. depth-f_levels)
-        for l in range(f_levels):
-            seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method,
-                                aes_impl)
+    seeds = last[:, None, :]  # [B, 1, 4]
+    f_levels = int(np.log2(f))
+    # Phase 1: root -> frontier (levels depth-1 .. depth-f_levels)
+    for l in range(f_levels):
+        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method,
+                            aes_impl, round_unroll)
 
-        def expand_subtree(node_seeds):
-            """[B, 4] frontier seeds -> [B, C] low-32 leaf shares."""
-            s = node_seeds[:, None, :]
-            for l in range(f_levels, depth):
-                s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method,
-                                aes_impl)
-            return s[..., 0].astype(jnp.int32)  # low limb, [B, C]
+    def expand_subtree(node_seeds):
+        """[B, 4] frontier seeds -> [B, C] low-32 leaf shares."""
+        s = node_seeds[:, None, :]
+        for l in range(f_levels, depth):
+            s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method,
+                            aes_impl, round_unroll)
+        return s[..., 0].astype(jnp.int32)  # low limb, [B, C]
 
-        table_chunks = table_perm.reshape(f, c, e)
+    table_chunks = table_perm.reshape(f, c, e)
 
-        if f == 1:
-            leaves = expand_subtree(seeds[:, 0, :])
-            return _dot_i32(leaves, table_chunks[0], dot_impl)
+    if f == 1:
+        leaves = expand_subtree(seeds[:, 0, :])
+        return _dot_i32(leaves, table_chunks[0], dot_impl)
 
-        frontier = jnp.moveaxis(seeds, 1, 0)  # [F, B, 4]
+    frontier = jnp.moveaxis(seeds, 1, 0)  # [F, B, 4]
 
-        def body(acc, xs):
-            node_seeds, chunk = xs
-            leaves = expand_subtree(node_seeds)         # [B, C] int32
-            return acc + _dot_i32(leaves, chunk, dot_impl), None
+    def body(acc, xs):
+        node_seeds, chunk = xs
+        leaves = expand_subtree(node_seeds)         # [B, C] int32
+        return acc + _dot_i32(leaves, chunk, dot_impl), None
 
-        acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
-        acc, _ = lax.scan(body, acc0, (frontier, table_chunks))
-        return acc
-    finally:
-        _prf_mod.ROUND_UNROLL = saved_unroll
+    acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
+    acc, _ = lax.scan(body, acc0, (frontier, table_chunks))
+    return acc
 
 
 def _dot_i32(a, b, impl: str | None = None):

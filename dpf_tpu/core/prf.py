@@ -271,7 +271,7 @@ def _salsa_state(seeds, pos: int):
     return jnp.stack(x)
 
 
-def prf_salsa20_12_jax(seeds, pos: int):
+def prf_salsa20_12_jax(seeds, pos: int, unroll: bool | None = None):
     import jax
     import jax.numpy as jnp
     init = _salsa_state(seeds, pos)
@@ -288,7 +288,8 @@ def prf_salsa20_12_jax(seeds, pos: int):
         return jnp.stack(x)
 
     x = jax.lax.fori_loop(0, 6, double_round, init,
-                          unroll=_round_unroll())
+                          unroll=_round_unroll() if unroll is None
+                          else unroll)
     out = x + init
     return u128._stack_last([out[4], out[3], out[2], out[1]])
 
@@ -304,7 +305,7 @@ def _chacha_state(seeds, pos: int):
     return jnp.stack(x)
 
 
-def prf_chacha20_12_jax(seeds, pos: int):
+def prf_chacha20_12_jax(seeds, pos: int, unroll: bool | None = None):
     import jax
     import jax.numpy as jnp
     init = _chacha_state(seeds, pos)
@@ -325,7 +326,8 @@ def prf_chacha20_12_jax(seeds, pos: int):
         return jnp.stack(x)
 
     x = jax.lax.fori_loop(0, 6, double_round, init,
-                          unroll=_round_unroll())
+                          unroll=_round_unroll() if unroll is None
+                          else unroll)
     out = x + init
     return u128._stack_last([out[7], out[6], out[5], out[4]])
 
@@ -365,7 +367,7 @@ def _aes_mix_columns_jax(x):
     return jnp.stack(ns)
 
 
-def prf_aes128_jax(seeds, pos: int):
+def prf_aes128_jax(seeds, pos: int, unroll: bool | None = None):
     """AES-128 with the 9 uniform middle rounds in a fori_loop."""
     import jax
     import jax.numpy as jnp
@@ -395,7 +397,8 @@ def prf_aes128_jax(seeds, pos: int):
         return (st ^ rk, rk)
 
     st, rk = jax.lax.fori_loop(1, 10, round_body, (st, rk),
-                              unroll=_round_unroll())
+                              unroll=_round_unroll() if unroll is None
+                              else unroll)
     # final round: no MixColumns
     st = sbox[st][_SHIFT_ROWS]
     rk = next_round_key(rk, 10)
@@ -422,14 +425,16 @@ PRF_V_JAX = {
 }
 
 
-def prf_v(method: int, seeds, pos: int):
+def prf_v(method: int, seeds, pos: int, unroll: bool | None = None):
     """Vectorized PRF dispatch; `method` and `pos` are static."""
     if isinstance(seeds, np.ndarray):
         return PRF_V_NUMPY[method](seeds, pos)
-    return PRF_V_JAX[method](seeds, pos)
+    if method == PRF_DUMMY:
+        return prf_dummy_v(seeds, pos)
+    return PRF_V_JAX[method](seeds, pos, unroll)
 
 
-def prf_aes128_pair_jax(seeds):
+def prf_aes128_pair_jax(seeds, unroll: bool | None = None):
     """AES of positions 0 AND 1 under the same per-seed key.
 
     The GGM level step always needs both children of a node; their AES keys
@@ -462,7 +467,8 @@ def prf_aes128_pair_jax(seeds):
         return (st0 ^ rk, st1 ^ rk, rk)
 
     st0, st1, rk = jax.lax.fori_loop(1, 10, round_body, (st0, st1, rk),
-                                     unroll=_round_unroll())
+                                     unroll=_round_unroll() if unroll is None
+                                     else unroll)
     rk = next_round_key(rk, 10)
     st0 = sbox[st0][_SHIFT_ROWS] ^ rk
     st1 = sbox[st1][_SHIFT_ROWS] ^ rk
@@ -481,23 +487,24 @@ def _aes_pair_impl() -> str:
     return "bitsliced" if _default_backend_tpu() else "gather"
 
 
-def prf_pair(method: int, seeds, aes_impl: str | None = None):
+def prf_pair(method: int, seeds, aes_impl: str | None = None,
+             unroll: bool | None = None):
     """Both children PRF(seed, 0), PRF(seed, 1) — fused where profitable.
 
     For AES the key schedule is shared between the two children; on TPU the
     whole cipher additionally runs bitsliced (no gathers) — see
     ``aes_bitsliced.py``.  All variants are bit-identical.  ``aes_impl``
-    must be threaded from a jit *static* argument by callers inside jit
-    (module default otherwise) so switching implementations retraces.
+    and ``unroll`` must be threaded from jit *static* arguments by callers
+    inside jit (module defaults otherwise) so switching retraces.
     """
     if not isinstance(seeds, np.ndarray) and method == PRF_AES128:
         impl = (aes_impl if aes_impl not in (None, "auto")
                 else _aes_pair_impl())
         if impl == "bitsliced":
             from .aes_bitsliced import aes128_pair_bitsliced
-            return aes128_pair_bitsliced(seeds)
-        return prf_aes128_pair_jax(seeds)
-    return prf_v(method, seeds, 0), prf_v(method, seeds, 1)
+            return aes128_pair_bitsliced(seeds, unroll)
+        return prf_aes128_pair_jax(seeds, unroll)
+    return prf_v(method, seeds, 0, unroll), prf_v(method, seeds, 1, unroll)
 
 
 def _default_backend_tpu() -> bool:

@@ -36,7 +36,9 @@ def initialize(coordinator_address: str | None = None,
                                    num_processes=num_processes,
                                    process_id=process_id)
         return True
-    except Exception:
+    except Exception as e:
+        if "already initialized" in str(e).lower():
+            return True  # idempotent: an earlier component initialized it
         if (coordinator_address is not None or num_processes is not None
                 or process_id is not None or _cluster_expected()):
             raise  # a real cluster failed to initialize: surface it

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""One-shot TPU tuning sweep: measure every knob combination, report best.
+
+Run on real TPU hardware (takes tens of minutes — each combination
+compiles its own program):
+
+  python experiments/tpu_tuning.py [--out tpu_tuning.json] [--quick]
+
+Measures dpfs/sec for the headline configs across
+  aes_impl {gather, bitsliced} x round_unroll {False, True}
+  x dot_impl {i32, mxu}  (dot only matters at the contraction)
+and prints a result-dict line per point plus a final summary with the
+winning EvalConfig per PRF.
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="tpu_tuning.json")
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--entries", type=int, default=None)
+    args = ap.parse_args()
+
+    import dpf_tpu
+    from dpf_tpu.utils.bench import test_dpf_perf
+    from dpf_tpu.utils.config import EvalConfig
+
+    n = args.entries or (16384 if args.quick else 65536)
+    batch = 128 if args.quick else 512
+    reps = 3 if args.quick else 10
+
+    results = []
+
+    def measure(prf, **knobs):
+        cfg = EvalConfig(prf_method=prf, batch_size=batch, **knobs)
+        cfg.apply_globals()
+        try:
+            r = test_dpf_perf(N=n, batch=batch, prf=prf, reps=reps,
+                              quiet=True)
+        except Exception as e:  # record failures, keep sweeping
+            r = {"error": str(e)[:200], "dpfs_per_sec": 0}
+        r.update({"knobs": knobs, "prf_id": prf})
+        results.append(r)
+        print(json.dumps(r))
+        return r["dpfs_per_sec"]
+
+    # AES: the headline; all knob combos
+    for aes_impl, unroll, dot in itertools.product(
+            ("gather", "bitsliced"), (False, True), ("i32", "mxu")):
+        measure(dpf_tpu.PRF_AES128, aes_impl=aes_impl, round_unroll=unroll,
+                dot_impl=dot)
+    # ChaCha/Salsa: unroll x dot
+    for prf in (dpf_tpu.PRF_CHACHA20, dpf_tpu.PRF_SALSA20):
+        for unroll, dot in itertools.product((False, True), ("i32", "mxu")):
+            measure(prf, round_unroll=unroll, dot_impl=dot)
+
+    best = {}
+    for r in results:
+        if "error" in r:
+            continue
+        key = r["prf"]
+        if key not in best or r["dpfs_per_sec"] > best[key]["dpfs_per_sec"]:
+            best[key] = r
+    summary = {"entries": n, "batch": batch,
+               "best": {k: {"dpfs_per_sec": v["dpfs_per_sec"],
+                            "knobs": v["knobs"]} for k, v in best.items()}}
+    with open(args.out, "w") as f:
+        json.dump({"results": results, "summary": summary}, f, indent=1)
+    print(json.dumps(summary))
+
+
+if __name__ == "__main__":
+    main()
