@@ -56,6 +56,34 @@ def test_dpf_perf(N=16384, batch=512, entrysize=16, prf=None, reps=10,
     return result
 
 
+def test_dpf_latency(N=16384, entrysize=16, prf=None, reps=20, quiet=False):
+    """Single-query latency (the reference's latency benchmark mode,
+    ``dpf_benchmark.cu:242-276``): one key, one dispatch, wall-clock ms."""
+    from ..api import DPF
+
+    dpf = DPF(prf=prf)
+    k1, _ = dpf.gen(N // 3, N)
+    table = np.random.randint(0, 2 ** 31, (N, entrysize),
+                              dtype=np.int64).astype(np.int32)
+    dpf.eval_init(table)
+    dpf.eval_tpu([k1])  # compile + warm
+    t0 = time.time()
+    for _ in range(reps):
+        dpf.eval_tpu([k1])
+    elapsed = time.time() - t0
+    result = {
+        "mode": "latency",
+        "entries": N,
+        "entry_size": entrysize,
+        "prf": dpf.prf_method_string,
+        "reps": reps,
+        "latency_ms": round(1e3 * elapsed / reps, 3),
+    }
+    if not quiet:
+        print(json.dumps(result))
+    return result
+
+
 def test_matmul_perf(B=512, K=65536, E=16, reps=10, quiet=False):
     """Benchmark the contraction strategies alone (role of the reference's
     ``dpf_gpu/matmul_benchmark.cu``): [B,K] x [K,E] exact mod-2^32."""

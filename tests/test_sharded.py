@@ -74,3 +74,37 @@ def test_sharded_large_table_small_shards(eight_devices):
 def test_mesh_validation():
     with pytest.raises(AssertionError):
         sharded.make_mesh(n_table=3, n_batch=2)  # 6 != 8 devices
+
+
+def test_sharded_large_table_smoke(eight_devices):
+    """Scaled-down rehearsal of the 2^26-rows-over-8-chips config
+    (BASELINE config 4): a table big enough that each chip owns many
+    frontier subtrees and the scan path streams dozens of tiles."""
+    n = 1 << 16
+    dpf = DPF(prf=DPF.PRF_DUMMY)
+    table = np.random.randint(-2 ** 31, 2 ** 31, (n, 16),
+                              dtype=np.int64).astype(np.int32)
+    idxs = [0, 12345, n - 1]
+    keys = [dpf.gen(i, n) for i in idxs]
+    mesh = sharded.make_mesh(n_table=8, n_batch=1)
+    srv = sharded.ShardedDPFServer(table, mesh, prf_method=DPF.PRF_DUMMY,
+                                   batch_size=4)
+    srv.chunk = 1024  # 8 subtrees per chip
+    rec = (srv.eval([k[0] for k in keys])
+           - srv.eval([k[1] for k in keys])).astype(np.int32)
+    assert (rec == table[idxs]).all()
+
+
+def test_single_query_whole_mesh_latency_path(eight_devices):
+    """The coop-kernel analogue (reference dpf_gpu/dpf/dpf_coop.cu):
+    batch=1, every chip works on the one query via table sharding."""
+    n = 4096
+    dpf = DPF(prf=DPF.PRF_SALSA20)
+    table = np.random.randint(0, 2 ** 31, (n, 8),
+                              dtype=np.int64).astype(np.int32)
+    k1, k2 = dpf.gen(2025, n)
+    srv = sharded.ShardedDPFServer(table, sharded.make_mesh(n_table=8),
+                                   prf_method=DPF.PRF_SALSA20, batch_size=1)
+    rec = (srv.eval([k1]) - srv.eval([k2])).astype(np.int32)
+    assert rec.shape == (1, 8)
+    assert (rec[0] == table[2025]).all()

@@ -79,6 +79,14 @@ def test_checkpoint_roundtrip(tmp_path):
                        np.asarray(jax.tree_util.tree_leaves(params)[0]))
 
 
+def test_latency_benchmark():
+    from dpf_tpu import PRF_DUMMY
+    from dpf_tpu.utils.bench import test_dpf_latency
+    r = test_dpf_latency(N=256, entrysize=4, prf=PRF_DUMMY, reps=2,
+                         quiet=True)
+    assert r["mode"] == "latency" and r["latency_ms"] > 0
+
+
 def test_cpu_baseline_harness():
     from dpf_tpu import native
     if not native.available():
