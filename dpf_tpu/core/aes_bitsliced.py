@@ -130,8 +130,10 @@ def _gf_sq(a):
     return out
 
 
-def _sbox_bits(a, ones):
-    """AES S-box: x^254 (= inverse, 0 -> 0) then affine (+0x63)."""
+def _sbox_bits_chain(a, ones):
+    """AES S-box via the x^254 square-and-multiply chain (~760 plane ops).
+
+    Kept as the independently-derived cross-check for the tower circuit."""
     x2 = _gf_sq(a)
     x3 = _gf_mul(x2, a)
     x15 = _gf_mul(_gf_sq(_gf_sq(x3)), x3)
@@ -146,6 +148,13 @@ def _sbox_bits(a, ones):
             acc = acc ^ ones
         out.append(acc)
     return out
+
+
+def _sbox_bits(a, ones):
+    """AES S-box on 8 bit-tensors — composite-field GF((2^4)^2) circuit
+    (~170 plane ops; see aes_sbox_circuit.py for the derivation)."""
+    from .aes_sbox_circuit import sbox_bits_tower
+    return sbox_bits_tower(a, ones)
 
 
 # ---------------------------------------------------------------------------

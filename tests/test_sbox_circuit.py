@@ -1,0 +1,91 @@
+"""Composite-field S-box circuit tests (dpf_tpu/core/aes_sbox_circuit)."""
+
+import numpy as np
+
+from dpf_tpu.core import aes_bitsliced, aes_sbox_circuit as asc, prf_ref
+
+
+def _planes_for(vals):
+    bits = [np.where((vals >> b) & 1 == 1, np.uint32(0xFFFFFFFF),
+                     np.uint32(0)) for b in range(8)]
+    ones = np.full_like(vals, 0xFFFFFFFF)
+    return bits, ones
+
+
+def _collect(bits):
+    out = np.zeros_like(bits[0])
+    for b in range(8):
+        out |= (bits[b] & 1) << b
+    return out
+
+
+def test_tower_sbox_all_256():
+    vals = np.arange(256, dtype=np.uint32)
+    bits, ones = _planes_for(vals)
+    got = _collect(asc.sbox_bits_tower(bits, ones))
+    want = np.array(prf_ref.SBOX, dtype=np.uint32)
+    assert (got == want).all()
+
+
+def test_tower_matches_chain_circuit():
+    """Two independently derived circuits must agree everywhere."""
+    vals = np.arange(256, dtype=np.uint32)
+    bits, ones = _planes_for(vals)
+    tower = _collect(asc.sbox_bits_tower(bits, ones))
+    chain = _collect(aes_bitsliced._sbox_bits_chain(bits, ones))
+    assert (tower == chain).all()
+
+
+def test_derived_constants_sane():
+    # lambda irreducible: z^2 + z + lam has no root in GF(2^4)
+    lam = asc._LAM
+    assert all((asc._gf4_mul(r, r) ^ r ^ lam) != 0 for r in range(16))
+    # isomorphism matrices invert each other
+    eye = (asc._T @ asc._TINV) % 2
+    assert (eye == np.eye(8, dtype=np.uint8)).all()
+    # gf4 inverse table correct
+    for a in range(1, 16):
+        assert asc._gf4_mul(a, asc._GF4_INV[a]) == 1
+
+
+def test_tower_circuit_is_smaller():
+    """Count plane ops symbolically: the tower circuit must be much smaller
+    than the chain (this is its reason to exist)."""
+
+    class OpCounter:
+        __slots__ = ("n",)
+
+        def __init__(self, n=0):
+            self.n = n
+
+        def _op(self, other):
+            return OpCounter(self.n + 1)
+
+        __xor__ = __and__ = _op
+
+    def count(fn):
+        bits = [OpCounter() for _ in range(8)]
+        ones = OpCounter()
+        before = 0
+        out = fn(bits, ones)
+        return max(o.n for o in out if isinstance(o, OpCounter)) or before
+
+    # rough proxy: depth of op chains; the real measure is emitted-op count,
+    # so count via tracing lists
+    ops = {"tower": 0, "chain": 0}
+
+    class Rec:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def __xor__(self, other):
+            ops[self.tag] += 1
+            return self
+
+        __and__ = __xor__
+
+    for tag, fn in (("tower", asc.sbox_bits_tower),
+                    ("chain", aes_bitsliced._sbox_bits_chain)):
+        bits = [Rec(tag) for _ in range(8)]
+        fn(bits, Rec(tag))
+    assert ops["tower"] < ops["chain"] / 3, ops
