@@ -1,0 +1,107 @@
+"""Experimental Pallas TPU kernel for the GGM level step (ChaCha20-12).
+
+The default expansion path relies on XLA fusing the unrolled cipher rounds
+into VPU pipelines (see docs/PERFORMANCE.md — at ~25 int-ops/byte the level
+step is solidly compute-bound, so fusion should reach the roofline).  This
+kernel is the hand-scheduled alternative for A/B measurement: one
+``pallas_call`` computes both children of every node with all 12 rounds
+resident in VMEM, fused with the codeword-select-add — no intermediate HBM
+traffic even if XLA's fusion heuristics decline.
+
+Layout: the kernel works limb-major ([4, B, w] — lanes along the wide node
+axis); the [B, w, 4] <-> limb-major transposes sit at the kernel boundary
+inside jit where they are negligible next to the cipher.
+
+Correctness is asserted against the portable path in tests (interpret mode
+on CPU; compiled on TPU).  Only ChaCha20-12 for now — the PRF with the
+best measured throughput profile; extending to Salsa is mechanical.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.prf import _SIGMA
+
+
+def _rotl(x, b):
+    return (x << np.uint32(b)) | (x >> np.uint32(32 - b))
+
+
+def _chacha_pair_kernel(seeds_ref, cw1_ref, cw2_ref, out0_ref, out1_ref):
+    """seeds [4, TB, TW] u32; cw* [4, TB, 2] u32 (limb, key, branch);
+    out* [4, TB, TW] u32 — children for branch 0 and 1."""
+    s = [seeds_ref[i] for i in range(4)]        # [TB, TW] each
+
+    def core(pos_word):
+        zero = s[0] - s[0]
+        x = [zero + np.uint32(_SIGMA[i]) for i in range(4)]
+        x += [s[3], s[2], s[1], s[0]]
+        x += [zero] * 4
+        x += [zero, zero + np.uint32(pos_word), zero, zero]
+        init = list(x)
+        for _ in range(6):
+            for (a, b, c, d) in ((0, 4, 8, 12), (1, 5, 9, 13),
+                                 (2, 6, 10, 14), (3, 7, 11, 15),
+                                 (0, 5, 10, 15), (1, 6, 11, 12),
+                                 (2, 7, 8, 13), (3, 4, 9, 14)):
+                x[a] = x[a] + x[b]
+                x[d] = _rotl(x[d] ^ x[a], 16)
+                x[c] = x[c] + x[d]
+                x[b] = _rotl(x[b] ^ x[c], 12)
+                x[a] = x[a] + x[b]
+                x[d] = _rotl(x[d] ^ x[a], 8)
+                x[c] = x[c] + x[d]
+                x[b] = _rotl(x[b] ^ x[c], 7)
+        # output words 4..7 MSW-first -> limbs LSW-first
+        return [x[7] + init[7], x[6] + init[6], x[5] + init[5],
+                x[4] + init[4]]
+
+    sel = (s[0] & np.uint32(1)).astype(jnp.bool_)   # [TB, TW]
+    for branch, out_ref in ((0, out0_ref), (1, out1_ref)):
+        val = core(np.uint32(branch))
+        carry = None
+        for i in range(4):
+            cw_i = jnp.where(sel, cw2_ref[i, :, branch][:, None],
+                             cw1_ref[i, :, branch][:, None])
+            t = val[i] + cw_i
+            c1 = (t < val[i]).astype(jnp.uint32)
+            if carry is None:
+                out_ref[i] = t
+                carry = c1
+            else:
+                t2 = t + carry
+                c2 = (t2 < t).astype(jnp.uint32)
+                out_ref[i] = t2
+                carry = c1 | c2
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def chacha_level_step_pallas(seeds, cw1_lvl, cw2_lvl, interpret=False):
+    """One ChaCha GGM level via Pallas.
+
+    seeds: [B, w, 4] u32; cw*_lvl: [B, 2, 4] u32 (this level's codeword
+    pair per key).  Returns [B, 2w, 4] children (new[2j+b] layout).
+    """
+    from jax.experimental import pallas as pl
+
+    bsz, w, _ = seeds.shape
+    sm = jnp.transpose(seeds, (2, 0, 1))            # [4, B, w]
+    cw1 = jnp.transpose(cw1_lvl, (2, 0, 1))         # [4, B, 2]
+    cw2 = jnp.transpose(cw2_lvl, (2, 0, 1))
+
+    out_shape = [jax.ShapeDtypeStruct((4, bsz, w), jnp.uint32)] * 2
+    out0, out1 = pl.pallas_call(
+        _chacha_pair_kernel,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(sm, cw1, cw2)
+
+    children = jnp.stack([jnp.transpose(out0, (1, 2, 0)),
+                          jnp.transpose(out1, (1, 2, 0))], axis=2)
+    return children.reshape(bsz, 2 * w, 4)

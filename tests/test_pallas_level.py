@@ -1,0 +1,47 @@
+"""Pallas level-step kernel vs the portable path.
+
+Interpret mode costs ~30 s per pallas_call on CPU regardless of size
+(per-op interpreter overhead), so the default suite runs one minimal case;
+set DPF_RUN_SLOW=1 for the wider-shape case.  On TPU the same kernel
+compiles for real (see experiments/tpu_tuning.py for the A/B).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dpf_tpu.core import expand, keygen
+
+
+def _case(width_levels, n_keys=1):
+    from dpf_tpu.ops import pallas_level
+    n, method = 512, 2  # ChaCha20
+    flat = [keygen.generate_keys((i * 131) % n, n, b"plv%d" % i, method)[0]
+            for i in range(n_keys)]
+    cw1, cw2, last = expand.pack_keys(flat)
+    depth = 9
+    seeds = jnp.asarray(last)[:, None, :]
+    for l in range(width_levels):
+        seeds = expand._level_step(seeds, jnp.asarray(cw1),
+                                   jnp.asarray(cw2), depth - 1 - l, method)
+    i = depth - 1 - width_levels
+    want = expand._level_step(seeds, jnp.asarray(cw1), jnp.asarray(cw2),
+                              i, method)
+    got = pallas_level.chacha_level_step_pallas(
+        seeds, jnp.asarray(cw1[:, 2 * i:2 * i + 2, :]),
+        jnp.asarray(cw2[:, 2 * i:2 * i + 2, :]), interpret=True)
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_pallas_chacha_level_matches_portable():
+    _case(0)
+
+
+@pytest.mark.skipif(not os.environ.get("DPF_RUN_SLOW"),
+                    reason="interpret-mode cost grows steeply with shape; "
+                           "set DPF_RUN_SLOW=1 (or run compiled on TPU)")
+def test_pallas_chacha_level_wider():
+    _case(2, n_keys=2)
