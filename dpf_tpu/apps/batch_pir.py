@@ -257,31 +257,62 @@ def _pad_pow2(n, lo=128):
 class PrivateLookupServer:
     """Holds one bin-structured table; answers DPF queries per bin.
 
-    Each bin is padded to a power-of-two mini-table served by the TPU
-    backend; one batched eval answers one query round across all bins.
+    Each bin is padded to a power-of-two mini-table; bins of equal padded
+    size are stacked so one batched per-key-table evaluation
+    (``expand.expand_and_contract_per_key_tables``) answers one query round
+    across all of them in a single device dispatch — the reference's layer
+    loops bins on the host instead.
     """
 
     def __init__(self, table: np.ndarray, bins, prf=None):
         from ..api import DPF
+        from ..core import expand
+        self.prf_method = DPF.DEFAULT_PRF if prf is None else prf
         self.entry_size = table.shape[1]
         self.bins = [sorted(b) for b in bins]
-        self.dpfs = []
         self.bin_sizes = []
+        padded_tables = []
         for b in self.bins:
             sub = table[b] if b else np.zeros((1, self.entry_size), np.int32)
             n = _pad_pow2(len(sub))
             padded = np.zeros((n, self.entry_size), np.int32)
             padded[:len(sub)] = sub
-            d = DPF(prf=prf)
-            d.eval_init(padded)
-            self.dpfs.append(d)
+            padded_tables.append(padded)
             self.bin_sizes.append(n)
+        # group bins by padded size -> one stacked [G, n, E] device array each
+        import jax.numpy as jnp
+        self._groups = {}  # n -> (bin indices, stacked permuted tables)
+        for bi, (n, padded) in enumerate(zip(self.bin_sizes, padded_tables)):
+            self._groups.setdefault(n, [[], []])
+            self._groups[n][0].append(bi)
+            self._groups[n][1].append(expand.permute_table(padded))
+        self._groups = {
+            n: (idxs, jnp.asarray(np.stack(tbls)))
+            for n, (idxs, tbls) in self._groups.items()}
 
     def answer(self, keys_per_bin):
         """keys_per_bin: one serialized key per bin -> [n_bins, E] shares."""
-        return np.stack([
-            np.asarray(d.eval_tpu([k]))[0]
-            for d, k in zip(self.dpfs, keys_per_bin)])
+        from ..core import expand, keygen
+        from ..core import prf as _prf
+        out = np.zeros((len(self.bins), self.entry_size), np.int32)
+        for n, (idxs, tables) in self._groups.items():
+            flat = [keygen.deserialize_key(keys_per_bin[bi]) for bi in idxs]
+            for fk in flat:
+                if fk.n != n:
+                    raise ValueError(
+                        "key for bin of size %d got n=%d" % (n, fk.n))
+            cw1, cw2, last = expand.pack_keys(flat)
+            depth = n.bit_length() - 1
+            from ..ops import matmul128
+            shares = expand.expand_and_contract_per_key_tables(
+                cw1, cw2, last, tables, depth=depth,
+                prf_method=self.prf_method,
+                chunk_leaves=expand.choose_chunk(n, len(flat)),
+                dot_impl=matmul128.default_impl(),
+                aes_impl=_prf._aes_pair_impl(),
+                round_unroll=_prf.ROUND_UNROLL)
+            out[idxs] = np.asarray(shares)
+        return out
 
 
 class PrivateLookupClient:

@@ -72,30 +72,13 @@ def permute_table(table_i32: np.ndarray) -> np.ndarray:
     return np.ascontiguousarray(table_i32[u128.bit_reverse_indices(n)])
 
 
-@functools.partial(jax.jit, static_argnames=("depth", "prf_method",
-                                             "chunk_leaves", "dot_impl",
-                                             "aes_impl", "round_unroll"))
-def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
-                        prf_method: int, chunk_leaves: int,
-                        dot_impl: str = "i32", aes_impl: str | None = None,
-                        round_unroll: bool | None = None):
-    """Batched fused DPF evaluation.
-
-    Args:
-      cw1, cw2: [B, 64, 4] uint32 — per-key codeword limb arrays.
-      last:     [B, 4] uint32 — per-key start seeds.
-      table_perm: [N, E] int32 — bit-reverse-permuted table.
-      depth: log2(N); prf_method: static PRF id; chunk_leaves: C.
-
-    Returns [B, E] int32 server output shares.
-    """
-    n = table_perm.shape[0]
-    e = table_perm.shape[1]
+def _expand_contract_core(cw1, cw2, last, per_chunk_tables, dot_fn, *,
+                          depth, prf_method, f, aes_impl, round_unroll,
+                          out_width):
+    """Shared engine for the fused kernels: phase-1 frontier expansion, then
+    a scan over frontier subtrees applying `dot_fn(leaves, chunk)` against
+    `per_chunk_tables` ([F, ...] with chunk on the leading axis)."""
     bsz = last.shape[0]
-    c = chunk_leaves
-    f = n // c  # frontier width
-    assert c * f == n and depth == int(np.log2(n))
-
     seeds = last[:, None, :]  # [B, 1, 4]
     f_levels = int(np.log2(f))
     # Phase 1: root -> frontier (levels depth-1 .. depth-f_levels)
@@ -111,22 +94,46 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
                             aes_impl, round_unroll)
         return s[..., 0].astype(jnp.int32)  # low limb, [B, C]
 
-    table_chunks = table_perm.reshape(f, c, e)
-
     if f == 1:
-        leaves = expand_subtree(seeds[:, 0, :])
-        return _dot_i32(leaves, table_chunks[0], dot_impl)
+        return dot_fn(expand_subtree(seeds[:, 0, :]), per_chunk_tables[0])
 
     frontier = jnp.moveaxis(seeds, 1, 0)  # [F, B, 4]
 
     def body(acc, xs):
         node_seeds, chunk = xs
-        leaves = expand_subtree(node_seeds)         # [B, C] int32
-        return acc + _dot_i32(leaves, chunk, dot_impl), None
+        return acc + dot_fn(expand_subtree(node_seeds), chunk), None
 
-    acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
-    acc, _ = lax.scan(body, acc0, (frontier, table_chunks))
+    acc0 = jnp.zeros((bsz, out_width), dtype=jnp.int32)
+    acc, _ = lax.scan(body, acc0, (frontier, per_chunk_tables))
     return acc
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "prf_method",
+                                             "chunk_leaves", "dot_impl",
+                                             "aes_impl", "round_unroll"))
+def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
+                        prf_method: int, chunk_leaves: int,
+                        dot_impl: str = "i32", aes_impl: str | None = None,
+                        round_unroll: bool | None = None):
+    """Batched fused DPF evaluation against one shared table.
+
+    Args:
+      cw1, cw2: [B, 64, 4] uint32 — per-key codeword limb arrays.
+      last:     [B, 4] uint32 — per-key start seeds.
+      table_perm: [N, E] int32 — bit-reverse-permuted table.
+      depth: log2(N); prf_method: static PRF id; chunk_leaves: C.
+
+    Returns [B, E] int32 server output shares.
+    """
+    n, e = table_perm.shape
+    c = chunk_leaves
+    f = n // c  # frontier width
+    assert c * f == n and depth == int(np.log2(n))
+    return _expand_contract_core(
+        cw1, cw2, last, table_perm.reshape(f, c, e),
+        lambda leaves, chunk: _dot_i32(leaves, chunk, dot_impl),
+        depth=depth, prf_method=prf_method, f=f, aes_impl=aes_impl,
+        round_unroll=round_unroll, out_width=e)
 
 
 def _dot_i32(a, b, impl: str | None = None):
@@ -135,6 +142,47 @@ def _dot_i32(a, b, impl: str | None = None):
     Delegates to ops.matmul128 (switchable VPU int32 vs MXU int8-limb)."""
     from ..ops import matmul128
     return matmul128.dot(a, b, impl)
+
+
+@functools.partial(jax.jit, static_argnames=("depth", "prf_method",
+                                             "chunk_leaves", "dot_impl",
+                                             "aes_impl", "round_unroll"))
+def expand_and_contract_per_key_tables(
+        cw1, cw2, last, tables_perm, *, depth: int, prf_method: int,
+        chunk_leaves: int, dot_impl: str = "i32",
+        aes_impl: str | None = None, round_unroll: bool | None = None):
+    """Fused evaluation where every key has its OWN table.
+
+    tables_perm: [B, N, E] int32 (each bit-reverse-permuted).  Returns
+    [B, E] int32 shares: out[b] = sum_j leaf32[b, j] * tables_perm[b, j].
+
+    This serves the batch-PIR bin protocol natively: one dispatch answers
+    one query round across all equal-sized bins (the reference's layer
+    loops bins on the host).
+    """
+    bsz, n, e = tables_perm.shape
+    c = chunk_leaves
+    f = n // c
+    assert c * f == n and depth == int(np.log2(n))
+
+    def bdot(leaves, chunk):
+        # [B, C] x [B, C, E] -> [B, E], batched over keys, mod 2^32
+        from ..ops import matmul128
+        if (dot_impl or "i32") == "i32":
+            return lax.dot_general(
+                leaves, chunk, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.int32)
+        # mxu decomposition per key via vmap over the batch axis
+        return jax.vmap(lambda a, t: matmul128.dot(a[None, :], t,
+                                                   dot_impl)[0])(leaves,
+                                                                 chunk)
+
+    # chunk axis leads: [F, B, C, E]
+    chunks = jnp.moveaxis(tables_perm.reshape(bsz, f, c, e), 1, 0)
+    return _expand_contract_core(
+        cw1, cw2, last, chunks, bdot,
+        depth=depth, prf_method=prf_method, f=f, aes_impl=aes_impl,
+        round_unroll=round_unroll, out_width=e)
 
 
 def expand_leaves(cw1, cw2, last, *, depth: int, prf_method: int):

@@ -8,6 +8,10 @@
 //   line: "prf <method> <seed_hex> <pos_hex>"   -> prints PRF result hex
 //   line: "eval <method> <n_indices> <idx...> " followed by 524 int32
 //         (hex words, one line) -> prints low-32 eval results
+//   line: "gen <method> <alpha> <n> <mt_seed>"  -> runs the reference's own
+//         keygen (GenerateSeedsAndCodewordsLog + FlattenCodewords) and
+//         prints both servers' keys as 2x524 hex words in the shared wire
+//         layout (depth | cw1[64] | cw2[64] | last | n)
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -70,6 +74,30 @@ int main() {
         uint128_t r = EvaluateFlat(&k, i, method);
         std::cout << (uint32_t)r << "\n";
       }
+    } else if (op == "gen") {
+      int method, alpha, n;
+      unsigned long mt_seed;
+      std::cin >> method >> alpha >> n >> mt_seed;
+      std::mt19937 g(mt_seed);
+      SeedsCodewords* s = GenerateSeedsAndCodewordsLog(alpha, 1, n, g, method);
+      for (int srv = 0; srv < 2; srv++) {
+        SeedsCodewordsFlat f;
+        std::memset(&f, 0, sizeof(f));
+        FlattenCodewords(s, srv, &f);
+        std::vector<uint32_t> words(524, 0);
+        uint128_t* slots = (uint128_t*)words.data();
+        slots[0] = (uint128_t)f.depth;
+        std::memcpy(&slots[1], f.cw_1, sizeof(uint128_t) * 64);
+        std::memcpy(&slots[65], f.cw_2, sizeof(uint128_t) * 64);
+        slots[129] = f.last_keys[0];
+        slots[130] = (uint128_t)n;
+        for (int i = 0; i < 524; i++) {
+          char buf[9];
+          snprintf(buf, sizeof(buf), "%08x", words[i]);
+          std::cout << buf << (i == 523 ? "\n" : " ");
+        }
+      }
+      FreeSeedsCodewords(s);
     } else {
       return 1;
     }
