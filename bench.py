@@ -21,7 +21,8 @@ def _run(n):
     from dpf_tpu.utils.bench import test_dpf_perf
 
     r = test_dpf_perf(N=n, batch=512, entrysize=16,
-                      prf=dpf_tpu.PRF_AES128, reps=10, quiet=True)
+                      prf=dpf_tpu.PRF_AES128, reps=10, quiet=True,
+                      check=True)
     print(json.dumps({
         "metric": "dpfs/sec (entries=%d, entry_size=16, AES128, batch=512, "
                   "1 chip)" % n,

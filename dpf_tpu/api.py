@@ -180,6 +180,31 @@ class DPF(object):
     # Reference scripts call eval_gpu; on this framework that IS the TPU.
     eval_gpu = eval_tpu
 
+    def eval_points(self, keys, indices):
+        """Sparse evaluation: each key at the given indices only.
+
+        The "naive strategy" surface (reference ``dpf_gpu/dpf/dpf_naive.cu``):
+        O(Q log N) PRF calls per key instead of O(N) — useful for spot
+        checks or when only a few positions are needed.  Returns
+        [len(keys), len(indices)] int32 one-hot shares (low 32 bits),
+        independent of any table.
+        """
+        flat = [keygen.deserialize_key(k) for k in keys]
+        if not flat:
+            raise ValueError("empty key batch")
+        n = flat[0].n
+        for fk in flat:
+            if fk.n != n:
+                raise ValueError("keys for mixed table sizes")
+        idx = np.asarray(indices, dtype=np.uint64)
+        if idx.ndim != 1 or (idx >= n).any():
+            raise ValueError("indices must be 1D and < n=%d" % n)
+        cw1, cw2, last = expand.pack_keys(flat)
+        out = expand.eval_points(cw1, cw2, last, idx.astype(np.uint32),
+                                 depth=n.bit_length() - 1,
+                                 prf_method=self.prf_method)
+        return _maybe_torch(np.asarray(out), self._torch_io)
+
     def _eval_batch(self, keys) -> np.ndarray:
         flat = [keygen.deserialize_key(k) for k in keys]
         n = self.table_num_entries

@@ -15,23 +15,35 @@ import numpy as np
 
 
 def test_dpf_perf(N=16384, batch=512, entrysize=16, prf=None, reps=10,
-                  keys_distinct=8, quiet=False):
+                  keys_distinct=8, quiet=False, check=False):
     """Measure batched eval throughput; returns the result dict.
 
     Generates `keys_distinct` real keys and tiles them to `batch` (keygen is
     host-side and O(log N); tiling keeps setup time out of the measurement
     without changing device work, which is identical per key).
+
+    check=True verifies share recovery on the measured batch before timing
+    (the role of the reference harness's DUMMY-gated check_correct,
+    ``dpf_benchmark.cu:281-294`` — here exact for every PRF).
     """
     from ..api import DPF
 
     dpf = DPF(prf=prf)
-    ks = [dpf.gen(int(i * (N // max(keys_distinct, 1))) % N, N)[0]
-          for i in range(keys_distinct)]
+    idxs = [int(i * (N // max(keys_distinct, 1))) % N
+            for i in range(keys_distinct)]
+    pairs = [dpf.gen(i, N) for i in idxs]
+    ks = [p[0] for p in pairs]
     keys = [ks[i % keys_distinct] for i in range(batch)]
 
     table = np.random.randint(0, 2 ** 31, (N, entrysize),
                               dtype=np.int64).astype(np.int32)
     dpf.eval_init(table)
+
+    if check:
+        a = np.asarray(dpf.eval_tpu(ks))
+        b = np.asarray(dpf.eval_tpu([p[1] for p in pairs]))
+        rec = (a - b).astype(np.int32)
+        assert (rec == table[idxs]).all(), "share recovery check failed"
 
     dpf.eval_tpu(keys)  # compile + warm
     tstart = time.time()

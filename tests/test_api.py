@@ -136,6 +136,22 @@ def test_errors():
     assert "_uninitialized_" in repr(dpf)
 
 
+def test_eval_points_api():
+    """Sparse per-index evaluation through the public API."""
+    n, alpha = 512, 300
+    dpf = DPF(prf=DPF.PRF_SALSA20)
+    k1, k2 = dpf.gen(alpha, n)
+    idx = [alpha - 1, alpha, alpha + 1, 0]
+    a = np.asarray(dpf.eval_points([k1], idx))
+    b = np.asarray(dpf.eval_points([k2], idx))
+    d = a.view(np.uint32) - b.view(np.uint32)
+    assert list(d[0]) == [0, 1, 0, 0]
+    with pytest.raises(ValueError):
+        dpf.eval_points([k1], [n])  # out of range
+    with pytest.raises(ValueError):
+        dpf.eval_points([], [0])
+
+
 def test_wide_entries_non_strict():
     """strict=False lifts the 16-word entry cap (reference TODO dpf.py:16)."""
     n, e = 128, 24
