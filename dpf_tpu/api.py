@@ -103,15 +103,30 @@ class DPF(object):
 
     # ------------------------------------------------------------------ gen
 
+    @staticmethod
+    def _pow2_domain(n: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return p
+
     def gen(self, k, n, seed: bytes | None = None):
-        """Generate the two servers' keys for secret index k in [0, n)."""
-        if n & (n - 1) != 0:
-            raise ValueError(
-                "Table num entries (%d) must be a power of two" % n)
+        """Generate the two servers' keys for secret index k in [0, n).
+
+        With strict=False, non-power-of-two n is allowed (a reference TODO,
+        ``dpf.py:24``): keys are generated over the next power-of-two
+        domain, matching eval_init's zero-padding of the table.
+        """
         if k >= n:
             raise ValueError(
                 "k (%d), the selected element, must be less than n (%d), "
                 "the number of entries in the table" % (k, n))
+        if n & (n - 1) != 0:
+            if self.strict:
+                raise ValueError(
+                    "Table num entries (%d) must be a power of two "
+                    "(pass strict=False to auto-pad)" % n)
+            n = self._pow2_domain(n)
         if seed is None:
             seed = os.urandom(128)
         native_keys = _native_gen(k, n, seed, self.prf_method)
@@ -125,7 +140,10 @@ class DPF(object):
     # ----------------------------------------------------------- eval_init
 
     def eval_init(self, table):
-        """Upload a [N, E] integer table; pre-permutes rows for BFS order."""
+        """Upload a [N, E] integer table; pre-permutes rows for BFS order.
+
+        With strict=False, non-power-of-two N is zero-padded to the next
+        power of two (matching gen's domain rounding)."""
         self._torch_io = _is_torch(table)
         tbl = _to_numpy(table, np.int32)
         if tbl.ndim != 2:
@@ -136,8 +154,14 @@ class DPF(object):
                 "Table (%d) must have at least %d elements"
                 % (n, self.MIN_ENTRIES))
         if n & (n - 1) != 0:
-            raise ValueError(
-                "Table num entries (%d) must be a power of two" % n)
+            if self.strict:
+                raise ValueError(
+                    "Table num entries (%d) must be a power of two "
+                    "(pass strict=False to auto-pad)" % n)
+            n_pad = self._pow2_domain(n)
+            padded = np.zeros((n_pad, e), np.int32)
+            padded[:n] = tbl
+            tbl, n = padded, n_pad
         if self.strict and e > self.ENTRY_SIZE:
             raise ValueError(
                 "Table entry dimension (%d) must be <= %d "
@@ -169,16 +193,37 @@ class DPF(object):
             cur = keys[i:i + self.BATCH_SIZE]
             # pad to the next power of two (bounded compile-cache churn,
             # reference pads to a fixed 512: dpf.py:123-126)
-            padded = 1
-            while padded < len(cur):
-                padded *= 2
-            cur = cur + [cur[-1]] * (padded - len(cur))
+            cur = cur + [cur[-1]] * (self._pow2_domain(len(cur)) - len(cur))
             results.append(self._eval_batch(cur))
         out = np.concatenate(results)[:eff, :self.table_effective_entry_size]
         return _maybe_torch(out, self._torch_io)
 
     # Reference scripts call eval_gpu; on this framework that IS the TPU.
     eval_gpu = eval_tpu
+
+    def _pack_batch(self, keys):
+        """Deserialize + validate a key batch -> (packed arrays, n,
+        torch-ness of the inputs)."""
+        if not keys:
+            raise ValueError("empty key batch")
+        torch_io = any(_is_torch(k) for k in keys)
+        flat = [keygen.deserialize_key(k) for k in keys]
+        n = flat[0].n
+        for fk in flat:
+            if fk.n != n:
+                raise ValueError("keys for mixed table sizes")
+        return expand.pack_keys(flat), n, torch_io
+
+    def eval_one_hot(self, keys):
+        """Accelerated full one-hot expansion (a reference TODO,
+        ``dpf.py:30``): [len(keys), N] int32 shares in natural index order,
+        no table involved.  Memory is O(batch x N) — for large N prefer
+        eval_tpu (fused) or eval_points (sparse)."""
+        (cw1, cw2, last), n, torch_io = self._pack_batch(keys)
+        out = expand.expand_leaves(cw1, cw2, last,
+                                   depth=n.bit_length() - 1,
+                                   prf_method=self.prf_method)
+        return _maybe_torch(np.asarray(out), torch_io)
 
     def eval_points(self, keys, indices):
         """Sparse evaluation: each key at the given indices only.
@@ -189,21 +234,14 @@ class DPF(object):
         [len(keys), len(indices)] int32 one-hot shares (low 32 bits),
         independent of any table.
         """
-        flat = [keygen.deserialize_key(k) for k in keys]
-        if not flat:
-            raise ValueError("empty key batch")
-        n = flat[0].n
-        for fk in flat:
-            if fk.n != n:
-                raise ValueError("keys for mixed table sizes")
+        (cw1, cw2, last), n, torch_io = self._pack_batch(keys)
         idx = np.asarray(indices, dtype=np.uint64)
         if idx.ndim != 1 or (idx >= n).any():
             raise ValueError("indices must be 1D and < n=%d" % n)
-        cw1, cw2, last = expand.pack_keys(flat)
         out = expand.eval_points(cw1, cw2, last, idx.astype(np.uint32),
                                  depth=n.bit_length() - 1,
                                  prf_method=self.prf_method)
-        return _maybe_torch(np.asarray(out), self._torch_io)
+        return _maybe_torch(np.asarray(out), torch_io)
 
     def _eval_batch(self, keys) -> np.ndarray:
         flat = [keygen.deserialize_key(k) for k in keys]

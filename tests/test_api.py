@@ -152,6 +152,40 @@ def test_eval_points_api():
         dpf.eval_points([], [0])
 
 
+def test_eval_one_hot_api():
+    n, alpha = 256, 99
+    dpf = DPF(prf=DPF.PRF_DUMMY)
+    k1, k2 = dpf.gen(alpha, n)
+    d = (np.asarray(dpf.eval_one_hot([k1])).view(np.uint32)
+         - np.asarray(dpf.eval_one_hot([k2])).view(np.uint32))
+    gt = np.zeros(n, np.uint32)
+    gt[alpha] = 1
+    assert (d[0] == gt).all()
+
+
+def test_non_pow2_table_non_strict():
+    """strict=False lifts the power-of-two constraint (reference TODO
+    dpf.py:24): keys and table auto-pad to the next power of two."""
+    n, e = 300, 5
+    dpf = DPF(prf=DPF.PRF_SALSA20, strict=False)
+    table = np.random.randint(0, 2 ** 31, (n, e),
+                              dtype=np.int64).astype(np.int32)
+    dpf.eval_init(table)
+    assert dpf.table_num_entries == 512
+    idxs = [0, 299, 150]
+    ks = [dpf.gen(i, n) for i in idxs]
+    rec = (np.asarray(dpf.eval_tpu([k[0] for k in ks]))
+           - np.asarray(dpf.eval_tpu([k[1] for k in ks]))).astype(np.int32)
+    assert (rec == table[idxs]).all()
+    with pytest.raises(ValueError):
+        dpf.gen(300, 300)  # k must stay below the LOGICAL n
+    # strict instance still rejects
+    with pytest.raises(ValueError):
+        DPF().eval_init(table)
+    with pytest.raises(ValueError):
+        DPF().gen(0, 300)
+
+
 def test_wide_entries_non_strict():
     """strict=False lifts the 16-word entry cap (reference TODO dpf.py:16)."""
     n, e = 128, 24
