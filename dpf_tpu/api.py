@@ -105,10 +105,8 @@ class DPF(object):
 
     @staticmethod
     def _pow2_domain(n: int) -> int:
-        p = 1
-        while p < n:
-            p *= 2
-        return p
+        from .core.u128 import next_pow2
+        return next_pow2(n)
 
     def gen(self, k, n, seed: bytes | None = None):
         """Generate the two servers' keys for secret index k in [0, n).

@@ -248,10 +248,8 @@ class BatchPIROptimize:
 # ---------------------------------------------------------------------------
 
 def _pad_pow2(n, lo=128):
-    p = lo
-    while p < n:
-        p *= 2
-    return p
+    from ..core.u128 import next_pow2
+    return next_pow2(max(n, lo))
 
 
 class PrivateLookupServer:

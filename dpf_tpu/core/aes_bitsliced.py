@@ -240,8 +240,7 @@ def _round(st0, st1, rk, rcon_word, ones):
 
 
 _RCON_VALS = [None, 1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36]
-_RCON_ARR = np.array([1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36],
-                     dtype=np.uint32)
+_RCON_ARR = np.array(_RCON_VALS[1:], dtype=np.uint32)
 
 
 def _middle_round(st0, st1, rk, rcon_word, ones):

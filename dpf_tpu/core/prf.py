@@ -24,11 +24,6 @@ from .prf_ref import PRF_AES128, PRF_CHACHA20, PRF_DUMMY, PRF_SALSA20, SBOX
 _SIGMA = (0x65787061, 0x6E642033, 0x322D6279, 0x7465206B)
 
 
-def _const(like, v):
-    """uint32 scalar constant broadcastable against `like`'s backend."""
-    return np.uint32(v)
-
-
 def _rotl(x, b: int):
     return (x << np.uint32(b)) | (x >> np.uint32(32 - b))
 
@@ -62,18 +57,18 @@ def _salsa_qr(x, a, b, c, d):
 def prf_salsa20_12_v(seeds, pos: int):
     """12-round Salsa20 core; key = seed words MSW-first in state 1..4."""
     zero = seeds[..., 0] - seeds[..., 0]
-    x = [zero + _const(seeds, 0)] * 16
-    x[0] = zero + _const(seeds, _SIGMA[0])
-    x[5] = zero + _const(seeds, _SIGMA[1])
-    x[10] = zero + _const(seeds, _SIGMA[2])
-    x[15] = zero + _const(seeds, _SIGMA[3])
+    x = [zero] * 16
+    x[0] = zero + np.uint32(_SIGMA[0])
+    x[5] = zero + np.uint32(_SIGMA[1])
+    x[10] = zero + np.uint32(_SIGMA[2])
+    x[15] = zero + np.uint32(_SIGMA[3])
     # seed limbs are little-endian; state words 1..4 take MSW..LSW
     x[1] = seeds[..., 3]
     x[2] = seeds[..., 2]
     x[3] = seeds[..., 1]
     x[4] = seeds[..., 0]
-    x[8] = zero + _const(seeds, (pos >> 32) & 0xFFFFFFFF)
-    x[9] = zero + _const(seeds, pos & 0xFFFFFFFF)
+    x[8] = zero + np.uint32((pos >> 32) & 0xFFFFFFFF)
+    x[9] = zero + np.uint32(pos & 0xFFFFFFFF)
     init = list(x)
     for _ in range(6):
         _salsa_qr(x, 0, 4, 8, 12)
@@ -105,15 +100,15 @@ def _chacha_qr(x, a, b, c, d):
 def prf_chacha20_12_v(seeds, pos: int):
     """12-round ChaCha core; key = seed words MSW-first in state 4..7."""
     zero = seeds[..., 0] - seeds[..., 0]
-    x = [zero + _const(seeds, 0)] * 16
+    x = [zero] * 16
     for i in range(4):
-        x[i] = zero + _const(seeds, _SIGMA[i])
+        x[i] = zero + np.uint32(_SIGMA[i])
     x[4] = seeds[..., 3]
     x[5] = seeds[..., 2]
     x[6] = seeds[..., 1]
     x[7] = seeds[..., 0]
-    x[12] = zero + _const(seeds, (pos >> 32) & 0xFFFFFFFF)
-    x[13] = zero + _const(seeds, pos & 0xFFFFFFFF)
+    x[12] = zero + np.uint32((pos >> 32) & 0xFFFFFFFF)
+    x[13] = zero + np.uint32(pos & 0xFFFFFFFF)
     init = list(x)
     for _ in range(6):
         _chacha_qr(x, 0, 4, 8, 12)

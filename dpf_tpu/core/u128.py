@@ -16,6 +16,8 @@ NumPy host reference and inside jitted TPU programs.
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 U32_MASK = 0xFFFFFFFF
@@ -186,6 +188,15 @@ def low32(a):
 # Bit reversal (host side; used once per eval_init to pre-permute the table)
 # ---------------------------------------------------------------------------
 
+def next_pow2(n: int) -> int:
+    """Smallest power of two >= n (n >= 1)."""
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@functools.lru_cache(maxsize=64)
 def bit_reverse_indices(n: int) -> np.ndarray:
     """Permutation p with p[i] = bit_reverse(i) over log2(n) bits.
 
@@ -200,4 +211,6 @@ def bit_reverse_indices(n: int) -> np.ndarray:
     rev = np.zeros_like(idx)
     for b in range(bits):
         rev |= ((idx >> b) & 1) << (bits - 1 - b)
-    return rev.astype(np.int64)
+    out = rev.astype(np.int64)
+    out.setflags(write=False)  # cached: guard against accidental mutation
+    return out

@@ -100,8 +100,9 @@ def eval_expand(key, prf_method: int):
     if lib is None:
         return None
     arr = np.ascontiguousarray(np.asarray(key, dtype=np.int32).reshape(-1))
-    n = int(arr.view(np.uint32)[520])  # wire slot 130 limb 0
-    n |= int(arr.view(np.uint32)[521]) << 32
+    # n lives in wire slot 130 (limbs 0 and 1): words 520/521 of 524
+    n_lo, n_hi = 130 * 4, 130 * 4 + 1
+    n = int(arr.view(np.uint32)[n_lo]) | (int(arr.view(np.uint32)[n_hi]) << 32)
     out = np.zeros(n, dtype=np.int32)
     rc = lib.dpftpu_eval_expand(
         arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), prf_method,
