@@ -1,0 +1,67 @@
+"""Randomized cross-path consistency fuzz.
+
+Every evaluation path in the framework must produce identical shares for
+the same key: scalar flat eval, vectorized NumPy BFS, the native C++
+runtime, device full expansion, device sparse walks, and the fused
+contraction.  A seeded fuzz over (n, alpha, prf) ties them all together
+(the reference's differential-testing idea, SURVEY.md §4, generalized)."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from dpf_tpu import DPF, native
+from dpf_tpu.core import evalref, expand, keygen
+
+RNG = np.random.default_rng(20260729)
+
+
+def _random_configs(k):
+    for _ in range(k):
+        n = 1 << int(RNG.integers(7, 12))
+        alpha = int(RNG.integers(0, n))
+        prf = int(RNG.choice([0, 0, 1, 2]))  # bias to cheap DUMMY
+        yield n, alpha, prf
+
+
+def test_all_paths_agree():
+    for n, alpha, prf in _random_configs(6):
+        seed = b"fuzz-%d-%d-%d" % (n, alpha, prf)
+        k0, k1 = keygen.generate_keys(alpha, n, seed, prf)
+
+        for fk in (k0, k1):
+            # 1. vectorized NumPy BFS (natural order, low 32)
+            hot = evalref.eval_one_hot_i32(fk, prf)
+
+            # 2. scalar flat eval at sampled indices
+            for i in {0, alpha, n - 1, int(RNG.integers(0, n))}:
+                want = keygen.evaluate_flat(fk, i, prf) & 0xFFFFFFFF
+                assert hot.view(np.uint32)[i] == want, (n, alpha, prf, i)
+
+            # 3. native runtime full expansion
+            if native.available():
+                nat = native.eval_expand(fk.serialize(), prf)
+                assert (nat == hot).all(), (n, alpha, prf)
+
+            # 4. device full expansion
+            cw1, cw2, last = expand.pack_keys([fk])
+            dev = np.asarray(expand.expand_leaves(
+                cw1, cw2, last, depth=n.bit_length() - 1, prf_method=prf))
+            assert (dev[0] == hot).all(), (n, alpha, prf)
+
+            # 5. device sparse walks at sampled indices
+            idx = np.array(sorted({0, alpha, n - 1}), np.uint32)
+            pts = np.asarray(expand.eval_points(
+                cw1, cw2, last, idx, depth=n.bit_length() - 1,
+                prf_method=prf))
+            assert (pts[0] == hot[idx.astype(np.int64)]).all()
+
+        # 6. fused contraction = one-hot x table, through the public API
+        table = RNG.integers(-2 ** 31, 2 ** 31, (n, 3),
+                             dtype=np.int64).astype(np.int32)
+        dpf = DPF(prf=prf)
+        dpf.eval_init(table)
+        a = np.asarray(dpf.eval_tpu([k0.serialize()]))
+        b = np.asarray(dpf.eval_tpu([k1.serialize()]))
+        assert ((a - b).astype(np.int32) == table[alpha]).all(), \
+            (n, alpha, prf)
