@@ -4,21 +4,17 @@ Multi-chip hardware is not required for tests — sharding correctness is
 validated on virtual CPU devices (the TPU answer to "multi-node without a
 cluster", SURVEY.md §4).
 
-This environment's sitecustomize registers the axon TPU plugin in every
-Python process and overrides ``jax_platforms`` to ``"axon,cpu"``, so env
-vars alone cannot force CPU (and a wedged TPU relay then hangs every first
-compile).  The config update below runs before any backend is initialized
-(conftest import precedes all test code), which keeps the axon backend
-dormant and all compiles local.
+The recipe (rewrite XLA_FLAGS + pin jax_platforms before any backend init,
+defeating the ambient axon sitecustomize) lives in
+``dpf_tpu.utils.hermetic.force_cpu_mesh``; conftest import precedes all
+test code, so this runs before any backend is initialized.
 """
 
 import os
+import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from dpf_tpu.utils.hermetic import force_cpu_mesh  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
+force_cpu_mesh(8)
