@@ -36,7 +36,10 @@ def _maybe_torch(arr, like_torch: bool):
     if like_torch:
         try:
             import torch
-            return torch.from_numpy(np.ascontiguousarray(arr))
+            arr = np.ascontiguousarray(arr)
+            if not arr.flags.writeable:  # e.g. a view of a JAX array
+                arr = arr.copy()
+            return torch.from_numpy(arr)
         except ImportError:
             pass
     return arr
@@ -189,11 +192,14 @@ class DPF(object):
         results = []
         for i in range(0, eff, self.BATCH_SIZE):
             cur = keys[i:i + self.BATCH_SIZE]
+            n_real = len(cur)
             # pad to the next power of two (bounded compile-cache churn,
             # reference pads to a fixed 512: dpf.py:123-126)
-            cur = cur + [cur[-1]] * (self._pow2_domain(len(cur)) - len(cur))
-            results.append(self._eval_batch(cur))
-        out = np.concatenate(results)[:eff, :self.table_effective_entry_size]
+            cur = cur + [cur[-1]] * (self._pow2_domain(n_real) - n_real)
+            # trim per chunk: with a non-power-of-two BATCH_SIZE, pad rows
+            # would otherwise land mid-output
+            results.append(self._eval_batch(cur)[:n_real])
+        out = np.concatenate(results)[:, :self.table_effective_entry_size]
         return _maybe_torch(out, self._torch_io)
 
     # Reference scripts call eval_gpu; on this framework that IS the TPU.

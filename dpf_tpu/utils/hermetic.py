@@ -23,11 +23,12 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
     """
     flags = os.environ.get("XLA_FLAGS", "")
     flag = "--xla_force_host_platform_device_count=%d" % n_devices
-    if "xla_force_host_platform_device_count" in flags:
-        flags = re.sub(r"--xla_force_host_platform_device_count=\d+",
-                       flag, flags)
-    else:
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
         flags = (flags + " " + flag).strip()
+    elif int(m.group(1)) < n_devices:
+        # raise a too-small pre-existing count; keep a larger user override
+        flags = flags[:m.start()] + flag + flags[m.end():]
     os.environ["XLA_FLAGS"] = flags
 
     import jax

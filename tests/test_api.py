@@ -195,3 +195,23 @@ def test_wide_entries_non_strict():
     dpf.eval_init(table)
     rec = np.asarray(dpf.eval_tpu(k1s)) - np.asarray(dpf.eval_tpu(k2s))
     assert (rec == table[idxs]).all()
+
+
+def test_non_pow2_batch_size_chunking():
+    """Regression: with a non-power-of-two BATCH_SIZE each dispatch chunk
+    is padded to the next power of two; pad rows must be trimmed per chunk,
+    not once at the concatenated tail (which recovered [1,2,3,3,4] for
+    keys [1..5] at BATCH_SIZE=3)."""
+    from dpf_tpu.utils.config import EvalConfig
+
+    n = 1024
+    cfg = EvalConfig(prf_method=DPF.PRF_DUMMY, batch_size=3)
+    dpf = DPF(config=cfg)
+    table = _structured_table(n)
+    dpf.eval_init(table)
+    idxs = [1, 2, 3, 4, 5]
+    k1s, k2s = zip(*(dpf.gen(i, n) for i in idxs))
+    a = np.asarray(dpf.eval_tpu(list(k1s)))
+    b = np.asarray(dpf.eval_tpu(list(k2s)))
+    rec = (a - b).astype(np.int32)
+    assert (rec == table[idxs]).all()
