@@ -152,7 +152,7 @@ def _sbox_bits_chain(a, ones):
 
 def _sbox_bits(a, ones):
     """AES S-box on 8 bit-tensors — composite-field GF((2^4)^2) circuit
-    (~170 plane ops; see aes_sbox_circuit.py for the derivation)."""
+    (193 plane ops; see aes_sbox_circuit.py for the derivation)."""
     from .aes_sbox_circuit import sbox_bits_tower
     return sbox_bits_tower(a, ones)
 

@@ -14,7 +14,8 @@ All linear steps (isomorphism in/out folded with squarings, lam-scaling,
 and the final affine) are 8x8 or 4x4 GF(2) matrices applied as XOR
 combinations; the nonlinear steps are three GF(2^4) multiplications
 (16 AND + ~15 XOR each) and one 4-bit inversion (ANF, ~20 ops).  Total
-~170 plane ops vs ~760 for the x^254 square-and-multiply chain.
+193 plane ops (symbolic count) vs ~760 for the x^254
+square-and-multiply chain.
 
 Everything is verified at import against the true S-box for all 256
 inputs (cheap scalar check); tests additionally exercise the bitsliced

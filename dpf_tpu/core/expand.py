@@ -42,7 +42,8 @@ MAX_CW = 64  # codeword slots in the wire format (2 per level, depth <= 32)
 
 
 def choose_chunk(n: int, batch: int) -> int:
-    """Leaves per phase-2 step: keep the live seed tensor ~32 MB."""
+    """Leaves per phase-2 step: bound the live seed tensor at 64 MiB
+    (B x C x 16 B with C = max(256, 2^22 / B); at B=512, C=8192)."""
     target = max(256, (1 << 22) // max(1, batch))
     c = 1
     while c * 2 <= min(n, target):

@@ -15,12 +15,13 @@ import numpy as np
 
 
 def test_dpf_perf(N=16384, batch=512, entrysize=16, prf=None, reps=10,
-                  keys_distinct=8, quiet=False, check=False):
+                  keys_distinct=None, quiet=False, check=False):
     """Measure batched eval throughput; returns the result dict.
 
-    Generates `keys_distinct` real keys and tiles them to `batch` (keygen is
-    host-side and O(log N); tiling keeps setup time out of the measurement
-    without changing device work, which is identical per key).
+    Every key in the measured batch is a distinct real key by default
+    (keygen is host-side and O(log N), so this costs seconds of setup and
+    keeps the headline number beyond reproach); pass a smaller
+    `keys_distinct` to tile instead — device work is identical per key.
 
     check=True verifies share recovery on the measured batch before timing
     (the role of the reference harness's DUMMY-gated check_correct,
@@ -29,8 +30,11 @@ def test_dpf_perf(N=16384, batch=512, entrysize=16, prf=None, reps=10,
     from ..api import DPF
 
     dpf = DPF(prf=prf)
-    idxs = [int(i * (N // max(keys_distinct, 1))) % N
-            for i in range(keys_distinct)]
+    if keys_distinct is None:
+        keys_distinct = batch
+    # odd multiplier is bijective mod the pow2 table size: indices are
+    # distinct (for keys_distinct <= N) and well-spread at any batch size
+    idxs = [(i * 0x9E3779B1) % N for i in range(keys_distinct)]
     pairs = [dpf.gen(i, N) for i in idxs]
     ks = [p[0] for p in pairs]
     keys = [ks[i % keys_distinct] for i in range(batch)]

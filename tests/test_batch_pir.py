@@ -117,3 +117,20 @@ def test_private_lookup_end_to_end():
     for w in wanted:
         assert w in got, "index %d not recovered" % w
         assert (got[w] == table[w]).all()
+
+
+def test_fetch_prefers_unrecovered_most_needed():
+    """Pin one_query's selection: with a tight budget, each per-bin query
+    must go to the most-needed *unrecovered* candidate — an
+    already-recovered entry in the bin must never absorb the query."""
+    # one bin holding {0, 1}; index 0 is far more popular than 1
+    train = [[0], [0], [0], [0, 1]]
+    val = [[0, 0, 1, 1]]  # duplicated needs: counts {0: 2, 1: 2}
+    opt = BatchPIROptimize(
+        train, val, HotColdConfig(1.0), CollocateConfig(0),
+        PIRConfig(bin_fraction=1.0, queries_to_hot=2, queries_to_cold=0))
+    recovered, _ = opt.fetch(val[0])
+    # 2 queries against a single 2-entry bin must recover both entries:
+    # round 1 takes one, round 2 must take the *other* (not re-take or
+    # discard on the recovered one)
+    assert recovered == {0, 1}
