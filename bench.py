@@ -5,72 +5,151 @@ Headline: server-side batched DPF evaluation throughput (dpfs/sec) at
 entries=65536, entry_size=16, PRF=AES-128, batch=512 on one TPU chip —
 the reference's V100 number for this config is 15,392 dpfs/sec
 (README.md:130); vs_baseline = ours / V100.
+
+Relay-safety design (docs/STATUS.md incident): killing a process while it
+is inside a TPU-relay compile wedges the relay for every later process.
+So this bench:
+
+* probes the backend with a tiny program first, and evaluates via
+  ``kernel_impl="dispatch"`` — one small XLA program per GGM level,
+  seconds each to compile — never one monolithic program whose compile
+  could outlive any watchdog;
+* runs both the probe and the measurement as **detached subprocesses**
+  (``start_new_session``) and, on timeout, *abandons* them (reports and
+  exits, leaving the child to finish or wait harmlessly) instead of
+  killing them mid-compile;
+* aborts on its soft deadline cooperatively *between* dispatches
+  (``expand.DeadlineExceeded``).
 """
 
 import json
 import os
+import subprocess
 import sys
-import threading
+import tempfile
+import time
 
 BASELINE_V100_AES128_65536 = 15392.0
-WATCHDOG_S = int(os.environ.get("DPF_BENCH_WATCHDOG_S", "1500"))
+PROBE_S = int(os.environ.get("DPF_BENCH_PROBE_S", "300"))
+SOFT_DEADLINE_S = int(os.environ.get("DPF_BENCH_SOFT_S", "1800"))
+WATCHDOG_S = int(os.environ.get("DPF_BENCH_WATCHDOG_S", "2700"))
 
 
-def _run(n):
-    import dpf_tpu
-    from dpf_tpu.utils.bench import test_dpf_perf
-
-    r = test_dpf_perf(N=n, batch=512, entrysize=16,
-                      prf=dpf_tpu.PRF_AES128, reps=10, quiet=True,
-                      check=True)
-    print(json.dumps({
+def _result(value, n, extra=None):
+    r = {
         "metric": "dpfs/sec (entries=%d, entry_size=16, AES128, batch=512, "
                   "1 chip)" % n,
-        "value": r["dpfs_per_sec"],
+        "value": value,
         "unit": "dpfs/sec",
-        "vs_baseline": round(r["dpfs_per_sec"] / BASELINE_V100_AES128_65536,
-                             4),
-    }), flush=True)
+        "vs_baseline": round(value / BASELINE_V100_AES128_65536, 4),
+    }
+    if extra:
+        r.update(extra)
+    print(json.dumps(r), flush=True)
+
+
+def _wait_abandon(proc, timeout_s):
+    """Wait for a detached child; on timeout leave it running (never kill
+    a process that may hold the TPU grant mid-compile)."""
+    try:
+        return proc.wait(timeout_s)
+    except subprocess.TimeoutExpired:
+        return None  # abandoned, still running
+
+
+def _probe_main():
+    import jax
+    import jax.numpy as jnp
+    jax.devices()
+    x = jnp.ones((128, 128), jnp.float32)
+    (x @ x).block_until_ready()
+    print("PROBE_OK", flush=True)
+
+
+def _run_main(n):
+    import numpy as np
+
+    import dpf_tpu
+    from dpf_tpu.utils.bench import test_dpf_perf
+    from dpf_tpu.utils.config import EvalConfig
+
+    batch = 512
+    cfg = EvalConfig(prf_method=dpf_tpu.PRF_AES128, batch_size=batch,
+                     kernel_impl="dispatch", round_unroll=False)
+    cfg.apply_globals()
+
+    # Warm phase THROUGH THE API (same code path and jit caches the
+    # measured run hits) with the cooperative deadline armed: every
+    # per-level program compiles here, abortable between dispatches.
+    deadline = time.time() + SOFT_DEADLINE_S
+    dpf = dpf_tpu.DPF(prf=dpf_tpu.PRF_AES128, config=cfg)
+    k1, _ = dpf.gen(7, n)
+    dpf.eval_init(np.zeros((n, 16), dtype=np.int32))
+    dpf.dispatch_deadline = deadline
+    dpf.eval_tpu([k1] * batch)
+
+    # Measured run via the shared harness: 512 distinct keys + exact
+    # share-recovery gate (check=True) + timed reps, under the same
+    # cooperative deadline.
+    r = test_dpf_perf(N=n, batch=batch, entrysize=16,
+                      prf=dpf_tpu.PRF_AES128, reps=10, quiet=True,
+                      check=True, config=cfg, dispatch_deadline=deadline)
+    _result(r["dpfs_per_sec"], n,
+            {"config": "dispatch/bitsliced-bp/loop-rounds",
+             "elapsed_s": r["elapsed_s"]})
 
 
 def main():
-    n = int(sys.argv[1]) if len(sys.argv) > 1 else 65536
-    # The TPU relay in this environment can wedge (any first compile hangs
-    # forever); a watchdog turns that into a diagnosable line instead of a
-    # silent hang.  Worker failures are re-reported as an error line +
-    # non-zero exit, never a silent success.
-    failure = []
+    pos = [a for a in sys.argv[1:] if not a.startswith("--")]
+    n = int(pos[0]) if pos else 65536
 
-    def run_guarded():
-        try:
-            _run(n)
-        except BaseException as e:  # noqa: BLE001 — reported below
-            failure.append(e)
+    if "--probe-worker" in sys.argv:
+        _probe_main()
+        return
+    if "--run-worker" in sys.argv:
+        _run_main(n)
+        return
 
-    worker = threading.Thread(target=run_guarded, daemon=True)
-    worker.start()
-    worker.join(WATCHDOG_S)
-    if failure:
-        print(json.dumps({
-            "metric": "dpfs/sec (entries=%d)" % n,
-            "value": 0,
-            "unit": "dpfs/sec",
-            "vs_baseline": 0.0,
-            "error": "%s: %s" % (type(failure[0]).__name__,
-                                 str(failure[0])[:300]),
-        }), flush=True)
-        os._exit(3)
-    if worker.is_alive():
-        print(json.dumps({
-            "metric": "dpfs/sec (entries=%d, entry_size=16, AES128, "
-                      "batch=512, 1 chip)" % n,
-            "value": 0,
-            "unit": "dpfs/sec",
-            "vs_baseline": 0.0,
-            "error": "TPU backend unresponsive after %ds (axon relay "
-                     "wedged?)" % WATCHDOG_S,
-        }), flush=True)
-        os._exit(2)
+    def spawn(argv):
+        fd, path = tempfile.mkstemp(prefix="dpf_bench_", suffix=".log")
+        child = subprocess.Popen(argv, stdout=fd, stderr=fd,
+                                 start_new_session=True)
+        os.close(fd)
+        return child, path
+
+    # Stage 1: relay probe in a detached child; abandon on timeout.
+    probe, probe_log = spawn(
+        [sys.executable, os.path.abspath(__file__), "--probe-worker"])
+    rc = _wait_abandon(probe, PROBE_S)
+    probe_ok = rc == 0 and "PROBE_OK" in open(probe_log).read()
+    if rc is None:
+        _result(0, n, {"error": "TPU relay unresponsive to a tiny probe "
+                                "program after %ds (wedged); probe child "
+                                "abandoned, not killed" % PROBE_S})
+        sys.exit(2)
+    if not probe_ok:
+        _result(0, n, {"error": "TPU probe exited rc=%s without PROBE_OK"
+                                % rc})
+        sys.exit(2)
+
+    # Stage 2: the measurement in a detached child; abandon on timeout.
+    worker, run_log = spawn(
+        [sys.executable, os.path.abspath(__file__), str(n), "--run-worker"])
+    rc = _wait_abandon(worker, WATCHDOG_S)
+    out = open(run_log).read().strip()
+    line = next((ln for ln in reversed(out.splitlines())
+                 if ln.startswith("{")), None)
+    if rc == 0 and line:
+        print(line, flush=True)
+        return
+    if rc is None:
+        _result(0, n, {"error": "TPU backend unresponsive after %ds "
+                                "(relay wedged mid-run?); measurement "
+                                "child abandoned, not killed" % WATCHDOG_S})
+        sys.exit(2)
+    _result(0, n, {"error": "measurement worker exited rc=%s; tail: %s"
+                            % (rc, out[-300:])})
+    sys.exit(3)
 
 
 if __name__ == "__main__":

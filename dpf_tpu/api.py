@@ -103,6 +103,10 @@ class DPF(object):
         self.table_effective_entry_size = None
         self._torch_io = False
         self.buffers = None           # reference-API compat handle
+        # optional time.time() soft deadline for kernel_impl="dispatch":
+        # checked between per-level programs (never interrupts a compile —
+        # relay safety, docs/STATUS.md); used by bench warm-up
+        self.dispatch_deadline = None
 
     # ------------------------------------------------------------------ gen
 
@@ -256,27 +260,42 @@ class DPF(object):
                     "key generated for n=%d but table has n=%d" % (fk.n, n))
         cw1, cw2, last = expand.pack_keys(flat)
         depth = n.bit_length() - 1
-        chunk = (self._config.chunk_leaves
-                 if self._config and self._config.chunk_leaves
-                 else expand.choose_chunk(n, len(flat)))
+        kernel_impl = self._config.kernel_impl if self._config else "xla"
+        if self._config and self._config.chunk_leaves:
+            chunk = self._config.chunk_leaves
+        elif kernel_impl == "pallas":
+            from .ops.pallas_level import pallas_chunk_leaves
+            chunk = pallas_chunk_leaves(n)
+        else:
+            chunk = expand.choose_chunk(n, len(flat))
         chunk = min(chunk, n)
         if n % chunk:
             raise ValueError(
                 "chunk_leaves (%d) must divide table size %d" % (chunk, n))
         from .core import prf as _prf
         from .ops import matmul128
+        dot_impl = (self._config.dot_impl if self._config else
+                    matmul128.default_impl())
+        aes_impl = (self._config.aes_impl if self._config and
+                    self._config.aes_impl != "auto" else
+                    _prf._aes_pair_impl())
+        round_unroll = (self._config.round_unroll
+                        if self._config and
+                        self._config.round_unroll is not None
+                        else _prf.ROUND_UNROLL)
+        if kernel_impl == "dispatch":
+            out = expand.eval_dispatch(
+                cw1, cw2, last, self.table_device, depth=depth,
+                prf_method=self.prf_method, chunk_leaves=chunk,
+                dot_impl=dot_impl, aes_impl=aes_impl,
+                round_unroll=round_unroll,
+                deadline=self.dispatch_deadline)
+            return np.asarray(out)
         out = expand.expand_and_contract(
             cw1, cw2, last, self.table_device, depth=depth,
             prf_method=self.prf_method, chunk_leaves=chunk,
-            dot_impl=self._config.dot_impl if self._config else
-            matmul128.default_impl(),
-            aes_impl=(self._config.aes_impl if self._config and
-                      self._config.aes_impl != "auto" else
-                      _prf._aes_pair_impl()),
-            round_unroll=(self._config.round_unroll
-                          if self._config and
-                          self._config.round_unroll is not None
-                          else _prf.ROUND_UNROLL))
+            dot_impl=dot_impl, aes_impl=aes_impl,
+            round_unroll=round_unroll, kernel_impl=kernel_impl)
         return np.asarray(out)
 
     # ------------------------------------------------------------ eval_cpu

@@ -150,11 +150,27 @@ def _sbox_bits_chain(a, ones):
     return out
 
 
-def _sbox_bits(a, ones):
-    """AES S-box on 8 bit-tensors — composite-field GF((2^4)^2) circuit
-    (193 plane ops; see aes_sbox_circuit.py for the derivation)."""
-    from .aes_sbox_circuit import sbox_bits_tower
-    return sbox_bits_tower(a, ones)
+SBOX_IMPL = "bp"  # "bp" | "tower" | "chain" — default: smallest circuit
+
+
+def _sbox_bits(a, ones, impl: str | None = None):
+    """AES S-box on 8 bit-tensors.  Three interchangeable circuits:
+
+    * ``bp``    — Boyar-Peralta shared-signal circuit, ~120 plane ops
+      (``aes_sbox_circuit_bp``; the default).
+    * ``tower`` — composite-field GF((2^4)^2) circuit, ~193 ops
+      (``aes_sbox_circuit.py``).
+    * ``chain`` — x^254 square-and-multiply, ~760 ops (cross-check only).
+    """
+    impl = impl or SBOX_IMPL
+    if impl == "bp":
+        from .aes_sbox_bp import sbox_bits_bp
+        return sbox_bits_bp(a, ones)
+    if impl == "tower":
+        from .aes_sbox_circuit import sbox_bits_tower
+        return sbox_bits_tower(a, ones)
+    assert impl == "chain", impl
+    return _sbox_bits_chain(a, ones)
 
 
 # ---------------------------------------------------------------------------
@@ -208,14 +224,14 @@ def _concat(parts):
     return jnp.concatenate(parts, axis=0)
 
 
-def _round(st0, st1, rk, rcon_word, ones):
+def _round(st0, st1, rk, rcon_word, ones, sbox: str | None = None):
     """One AES round on both states + schedule step.  `mix` outside for the
     final round.  Fuses all 36 S-box byte positions into one circuit pass.
     Returns (sub0, sub1, new_rk) with sub* = SubBytes(st*) (pre-ShiftRows).
     """
     fused_in = [_concat([st0[i], st1[i], rk[i][_ROT_WORD]])
                 for i in range(8)]
-    fused_out = _sbox_bits(fused_in, ones)
+    fused_out = _sbox_bits(fused_in, ones, sbox)
     sub0 = [f[:16] for f in fused_out]
     sub1 = [f[16:32] for f in fused_out]
     t = [f[32:36] for f in fused_out]
@@ -243,8 +259,8 @@ _RCON_VALS = [None, 1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36]
 _RCON_ARR = np.array(_RCON_VALS[1:], dtype=np.uint32)
 
 
-def _middle_round(st0, st1, rk, rcon_word, ones):
-    sub0, sub1, rk = _round(st0, st1, rk, rcon_word, ones)
+def _middle_round(st0, st1, rk, rcon_word, ones, sbox: str | None = None):
+    sub0, sub1, rk = _round(st0, st1, rk, rcon_word, ones, sbox)
     st0 = _mix_columns(_shift_rows(sub0))
     st1 = _mix_columns(_shift_rows(sub1))
     st0 = [st0[i] ^ rk[i] for i in range(8)]
@@ -252,13 +268,15 @@ def _middle_round(st0, st1, rk, rcon_word, ones):
     return st0, st1, rk
 
 
-def aes128_pair_bitsliced(seeds, unroll: bool | None = None):
+def aes128_pair_bitsliced(seeds, unroll: bool | None = None,
+                          sbox: str | None = None):
     """Bitsliced AES of positions 0 and 1 under per-element keys.
 
     seeds: [..., 4] uint32 limb array (NumPy or JAX) -> (out0, out1), same
     shape, matching ``prf_ref.prf_aes128(seed, 0/1)`` bit-exactly.  Under
     JAX the nine uniform middle rounds run in a ``fori_loop`` (honoring
-    ``unroll``, default = prf.ROUND_UNROLL auto).
+    ``unroll``, default = prf.ROUND_UNROLL auto).  ``sbox`` selects the
+    S-box circuit (see ``_sbox_bits``); thread it from a jit-static arg.
     """
     is_np = isinstance(seeds, np.ndarray)
     if is_np:
@@ -295,7 +313,8 @@ def aes128_pair_bitsliced(seeds, unroll: bool | None = None):
     if is_np:
         for rnd in range(1, 10):
             st0, st1, rk = _middle_round(st0, st1, rk,
-                                         np.uint32(_RCON_VALS[rnd]), ones)
+                                         np.uint32(_RCON_VALS[rnd]), ones,
+                                         sbox)
     else:
         import jax
         from . import prf as _prf
@@ -306,7 +325,8 @@ def aes128_pair_bitsliced(seeds, unroll: bool | None = None):
             st0 = [a[i] for i in range(8)]
             st1 = [b[i] for i in range(8)]
             rkl = [c[i] for i in range(8)]
-            st0, st1, rkl = _middle_round(st0, st1, rkl, rcon_arr[r], ones)
+            st0, st1, rkl = _middle_round(st0, st1, rkl, rcon_arr[r], ones,
+                                          sbox)
             return (xp.stack(st0), xp.stack(st1), xp.stack(rkl))
 
         carry = (xp.stack(st0), xp.stack(st1), xp.stack(rk))
@@ -318,7 +338,8 @@ def aes128_pair_bitsliced(seeds, unroll: bool | None = None):
         rk = [carry[2][i] for i in range(8)]
 
     # final round: Sub + Shift + ARK (no MixColumns)
-    sub0, sub1, rk = _round(st0, st1, rk, np.uint32(_RCON_VALS[10]), ones)
+    sub0, sub1, rk = _round(st0, st1, rk, np.uint32(_RCON_VALS[10]), ones,
+                            sbox)
     sh0, sh1 = _shift_rows(sub0), _shift_rows(sub1)
     st0 = [sh0[i] ^ rk[i] for i in range(8)]
     st1 = [sh1[i] ^ rk[i] for i in range(8)]

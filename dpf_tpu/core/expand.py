@@ -51,20 +51,36 @@ def choose_chunk(n: int, batch: int) -> int:
     return c
 
 
-def _level_step(seeds, cw1, cw2, i: int, prf_method: int,
-                aes_impl: str | None = None,
-                round_unroll: bool | None = None):
-    """One GGM level: [B, w, 4] -> [B, 2w, 4].  `i` is the flat level index."""
+def _level_step_pair(seeds, cw1_pair, cw2_pair, prf_method: int,
+                     aes_impl: str | None = None,
+                     round_unroll: bool | None = None):
+    """One GGM level with this level's codeword pairs passed directly.
+
+    seeds [B, w, 4]; cw*_pair [B, 2, 4] (branch, limb) -> [B, 2w, 4]."""
     sel = (seeds[..., 0] & np.uint32(1)).astype(bool)[..., None]  # [B, w, 1]
     prf_out = prf_pair(prf_method, seeds, aes_impl, round_unroll)
     children = []
     for b in (0, 1):
-        cw = jnp.where(sel, cw2[:, None, 2 * i + b, :],
-                       cw1[:, None, 2 * i + b, :])        # [B, w, 4]
+        cw = jnp.where(sel, cw2_pair[:, None, b, :],
+                       cw1_pair[:, None, b, :])           # [B, w, 4]
         children.append(u128.add128(prf_out[b], cw))
     stacked = jnp.stack(children, axis=2)                 # [B, w, 2, 4]
     bsz, w = seeds.shape[0], seeds.shape[1]
     return stacked.reshape(bsz, 2 * w, 4)
+
+
+_level_step_jit = jax.jit(_level_step_pair,
+                          static_argnames=("prf_method", "aes_impl",
+                                           "round_unroll"))
+
+
+def _level_step(seeds, cw1, cw2, i: int, prf_method: int,
+                aes_impl: str | None = None,
+                round_unroll: bool | None = None):
+    """One GGM level: [B, w, 4] -> [B, 2w, 4].  `i` is the flat level index."""
+    return _level_step_pair(seeds, cw1[:, 2 * i:2 * i + 2, :],
+                            cw2[:, 2 * i:2 * i + 2, :], prf_method,
+                            aes_impl, round_unroll)
 
 
 def permute_table(table_i32: np.ndarray) -> np.ndarray:
@@ -111,11 +127,13 @@ def _expand_contract_core(cw1, cw2, last, per_chunk_tables, dot_fn, *,
 
 @functools.partial(jax.jit, static_argnames=("depth", "prf_method",
                                              "chunk_leaves", "dot_impl",
-                                             "aes_impl", "round_unroll"))
+                                             "aes_impl", "round_unroll",
+                                             "kernel_impl"))
 def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
                         prf_method: int, chunk_leaves: int,
                         dot_impl: str = "i32", aes_impl: str | None = None,
-                        round_unroll: bool | None = None):
+                        round_unroll: bool | None = None,
+                        kernel_impl: str = "xla"):
     """Batched fused DPF evaluation against one shared table.
 
     Args:
@@ -123,6 +141,8 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
       last:     [B, 4] uint32 — per-key start seeds.
       table_perm: [N, E] int32 — bit-reverse-permuted table.
       depth: log2(N); prf_method: static PRF id; chunk_leaves: C.
+      kernel_impl: "xla" (scan + fused dot) or "pallas" (hand-scheduled
+        subtree kernel, ChaCha/Salsa — see ops/pallas_level.py).
 
     Returns [B, E] int32 server output shares.
     """
@@ -130,11 +150,115 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
     c = chunk_leaves
     f = n // c  # frontier width
     assert c * f == n and depth == int(np.log2(n))
+    if kernel_impl == "pallas":
+        from ..core.prf import PRF_CHACHA20, PRF_SALSA20
+        assert prf_method in (PRF_CHACHA20, PRF_SALSA20), (
+            "kernel_impl='pallas' supports ChaCha20/Salsa20 only")
+        return _expand_contract_pallas(cw1, cw2, last, table_perm,
+                                       depth=depth, f=f,
+                                       prf_method=prf_method)
     return _expand_contract_core(
         cw1, cw2, last, table_perm.reshape(f, c, e),
         lambda leaves, chunk: _dot_i32(leaves, chunk, dot_impl),
         depth=depth, prf_method=prf_method, f=f, aes_impl=aes_impl,
         round_unroll=round_unroll, out_width=e)
+
+
+@functools.partial(jax.jit, static_argnames=("dot_impl",))
+def _group_contract(acc, leaves, chunks, dot_impl: str = "i32"):
+    """acc [B,E] += einsum('bgc,gce->be') of group leaves x table chunks,
+    exact mod 2^32 (int32 wraparound).  The sum over (g, c) is a plain
+    [B, G*C] x [G*C, E] matmul, so both contraction impls apply."""
+    bsz = leaves.shape[0]
+    e = chunks.shape[-1]
+    return acc + _dot_i32(leaves.reshape(bsz, -1), chunks.reshape(-1, e),
+                          dot_impl)
+
+
+class DeadlineExceeded(RuntimeError):
+    """Raised by eval_dispatch between device programs when its soft
+    deadline passes — never mid-compile (killing a process that is inside
+    a TPU-relay compile wedges the relay for every later process; see
+    docs/STATUS.md)."""
+
+
+def eval_dispatch(cw1, cw2, last, table_perm, *, depth: int,
+                  prf_method: int, chunk_leaves: int, group: int | None = None,
+                  dot_impl: str = "i32", aes_impl: str | None = None,
+                  round_unroll: bool | None = None,
+                  deadline: float | None = None):
+    """Multi-dispatch evaluation: Python-driven per-level jitted steps.
+
+    Same math as ``expand_and_contract`` but split into one small XLA
+    program per GGM level (cached per width) plus a contraction step —
+    compile time grows linearly with depth instead of with the whole
+    unrolled program.  This matters for bitsliced AES, whose monolithic
+    graph (~16 level blocks x ~1.4K-op S-box circuits) can take tens of
+    minutes to compile; per-level graphs compile in seconds.  Dispatch
+    overhead is ~(levels + 1) x (F/G) host round-trips per batch.
+
+    group: frontier nodes expanded together per pass (default: as many as
+    keep the live leaf tensor under ~2^18 x batch x 16 B).
+    deadline: optional time.time() value; checked between dispatches
+    (cooperative — raises DeadlineExceeded without interrupting a compile).
+    """
+    import time as _time
+
+    def check_deadline():
+        if deadline is not None and _time.time() > deadline:
+            raise DeadlineExceeded(
+                "eval_dispatch soft deadline passed between dispatches")
+    n, e = table_perm.shape
+    c = chunk_leaves
+    f = n // c
+    assert c * f == n and depth == int(np.log2(n))
+    bsz = last.shape[0]
+    g = group or max(1, min(f, (1 << 18) // c))
+    while f % g:
+        g -= 1
+    f_levels = int(np.log2(f))
+
+    cw1 = jnp.asarray(cw1)
+    cw2 = jnp.asarray(cw2)
+
+    def pairs(i):
+        return cw1[:, 2 * i:2 * i + 2, :], cw2[:, 2 * i:2 * i + 2, :]
+
+    seeds = jnp.asarray(last)[:, None, :]
+    for l in range(f_levels):
+        check_deadline()
+        p1, p2 = pairs(depth - 1 - l)
+        seeds = _level_step_jit(seeds, p1, p2, prf_method, aes_impl,
+                                round_unroll)                 # [B, f, 4]
+
+    tables = jnp.asarray(table_perm).reshape(f, c, e)
+    acc = jnp.zeros((bsz, e), dtype=jnp.int32)
+    for start in range(0, f, g):
+        s = seeds[:, start:start + g, :]                      # [B, g, 4]
+        for l in range(f_levels, depth):
+            check_deadline()
+            p1, p2 = pairs(depth - 1 - l)
+            s = _level_step_jit(s, p1, p2, prf_method, aes_impl,
+                                round_unroll)
+        leaves = s[..., 0].astype(jnp.int32).reshape(bsz, g, c)
+        acc = _group_contract(acc, leaves, tables[start:start + g],
+                              dot_impl)
+    return acc
+
+
+def _expand_contract_pallas(cw1, cw2, last, table_perm, *, depth: int,
+                            f: int, interpret: bool = False,
+                            prf_method: int = 2):
+    """Phase-1 frontier via XLA (tiny), phase-2 via the fused Pallas
+    subtree kernel."""
+    from ..ops.pallas_level import subtree_contract_pallas
+    seeds = last[:, None, :]
+    f_levels = int(np.log2(f))
+    for l in range(f_levels):
+        seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method)
+    return subtree_contract_pallas(
+        seeds, cw1, cw2, table_perm, depth=depth, f_levels=f_levels,
+        interpret=interpret, prf_method=prf_method)
 
 
 def _dot_i32(a, b, impl: str | None = None):

@@ -495,9 +495,11 @@ def prf_pair(method: int, seeds, aes_impl: str | None = None,
     if not isinstance(seeds, np.ndarray) and method == PRF_AES128:
         impl = (aes_impl if aes_impl not in (None, "auto")
                 else _aes_pair_impl())
-        if impl == "bitsliced":
+        if impl.startswith("bitsliced"):
+            # "bitsliced" or "bitsliced:<sbox>" with sbox in bp/tower/chain
             from .aes_bitsliced import aes128_pair_bitsliced
-            return aes128_pair_bitsliced(seeds, unroll)
+            sbox = impl.split(":", 1)[1] if ":" in impl else None
+            return aes128_pair_bitsliced(seeds, unroll, sbox)
         return prf_aes128_pair_jax(seeds, unroll)
     return prf_v(method, seeds, 0, unroll), prf_v(method, seeds, 1, unroll)
 

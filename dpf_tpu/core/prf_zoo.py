@@ -83,6 +83,8 @@ def make_chacha_core(rounds: int):
     return fn
 
 
+from .prf_zoo_hash import HASH_ZOO  # noqa: E402 (needs _rotl et al above)
+
 ZOO = {
     "salsa20_8": make_salsa_core(8),
     "salsa20_12": make_salsa_core(12),
@@ -90,6 +92,7 @@ ZOO = {
     "chacha8": make_chacha_core(8),
     "chacha12": make_chacha_core(12),
     "chacha20": make_chacha_core(20),
+    **HASH_ZOO,
 }
 
 

@@ -1,20 +1,33 @@
-"""Experimental Pallas TPU kernel for the GGM level step (ChaCha20-12).
+"""Hand-scheduled Pallas TPU kernels for the GGM expansion hot path.
 
-The default expansion path relies on XLA fusing the unrolled cipher rounds
-into VPU pipelines (see docs/PERFORMANCE.md — at ~25 int-ops/byte the level
-step is solidly compute-bound, so fusion should reach the roofline).  This
-kernel is the hand-scheduled alternative for A/B measurement: one
-``pallas_call`` computes both children of every node with all 12 rounds
-resident in VMEM, fused with the codeword-select-add — no intermediate HBM
-traffic even if XLA's fusion heuristics decline.
+The XLA path (``core/expand.py``) relies on fusion for the cipher rounds
+but pays HBM round-trips for the ``[B, w, 4]`` seed tensors between tree
+levels (the ``lax.scan`` carry).  At ChaCha's ~25 int-ops/byte that
+traffic is comparable to the compute, so a fused kernel has up to ~2x of
+headroom.  This module supplies the hand-scheduled alternative — the role
+the reference's tuned hybrid kernel plays on GPU
+(``dpf_gpu/dpf/dpf_hybrid.cu:123-231``, DFS subtrees resident in shared
+memory) — redesigned for the TPU memory hierarchy:
 
-Layout: the kernel works limb-major ([4, B, w] — lanes along the wide node
-axis); the [B, w, 4] <-> limb-major transposes sit at the kernel boundary
-inside jit where they are negligible next to the cipher.
+* ``subtree_contract_pallas`` — the production kernel.  Grid
+  ``(B/TB, F)``: for each key tile, every frontier subtree is expanded
+  root-to-leaves **entirely in VMEM** (no inter-level HBM traffic), the
+  low-32 leaf shares are contracted against the matching table chunk, and
+  the ``[TB, E]`` accumulator stays resident in VMEM across the chunk
+  axis (the documented reduction-dim pattern: the innermost grid
+  dimension does not appear in the output index map).
+* ``chacha_level_step_pallas`` — a single tiled level step (kept for
+  layer-by-layer A/B measurement), grid over ``(B, w)`` tiles so VMEM
+  stays bounded at any width.
 
-Correctness is asserted against the portable path in tests (interpret mode
-on CPU; compiled on TPU).  Only ChaCha20-12 for now — the PRF with the
-best measured throughput profile; extending to Salsa is mechanical.
+Layout: limb-major ``[4, B, w]`` — the wide node axis rides the 128-wide
+lanes; the ``[B, w, 4]`` boundary transposes sit inside jit where they are
+negligible next to the cipher.
+
+Correctness: asserted against the portable XLA path in tests (interpret
+mode on CPU, compiled on TPU).  ChaCha20-12 and Salsa20-12 cores; the
+bitsliced-AES variant stays on the XLA dispatch path (its pack/unpack
+transposes do not benefit from manual scheduling).
 """
 
 from __future__ import annotations
@@ -25,6 +38,7 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from ..core.prf import _SIGMA
 
@@ -33,75 +47,249 @@ def _rotl(x, b):
     return (x << np.uint32(b)) | (x >> np.uint32(32 - b))
 
 
-def _chacha_pair_kernel(seeds_ref, cw1_ref, cw2_ref, out0_ref, out1_ref):
-    """seeds [4, TB, TW] u32; cw* [4, TB, 2] u32 (limb, key, branch);
-    out* [4, TB, TW] u32 — children for branch 0 and 1."""
-    s = [seeds_ref[i] for i in range(4)]        # [TB, TW] each
+def _chacha_core_planes(s, pos_word):
+    """ChaCha20-12 core on 4 seed planes (any common shape) -> 4 planes.
 
-    def core(pos_word):
-        zero = s[0] - s[0]
-        x = [zero + np.uint32(_SIGMA[i]) for i in range(4)]
-        x += [s[3], s[2], s[1], s[0]]
-        x += [zero] * 4
-        x += [zero, zero + np.uint32(pos_word), zero, zero]
-        init = list(x)
-        for _ in range(6):
-            for (a, b, c, d) in ((0, 4, 8, 12), (1, 5, 9, 13),
-                                 (2, 6, 10, 14), (3, 7, 11, 15),
-                                 (0, 5, 10, 15), (1, 6, 11, 12),
-                                 (2, 7, 8, 13), (3, 4, 9, 14)):
-                x[a] = x[a] + x[b]
-                x[d] = _rotl(x[d] ^ x[a], 16)
-                x[c] = x[c] + x[d]
-                x[b] = _rotl(x[b] ^ x[c], 12)
-                x[a] = x[a] + x[b]
-                x[d] = _rotl(x[d] ^ x[a], 8)
-                x[c] = x[c] + x[d]
-                x[b] = _rotl(x[b] ^ x[c], 7)
-        # output words 4..7 MSW-first -> limbs LSW-first
-        return [x[7] + init[7], x[6] + init[6], x[5] + init[5],
-                x[4] + init[4]]
+    Key/position placement matches ``core/prf._chacha_state`` (seed limbs
+    LSW-first occupy state words 7..4; output words 7..4 map to limbs
+    LSW-first) so results are bit-identical to the portable path.
+    """
+    zero = s[0] - s[0]
+    x = [zero + np.uint32(_SIGMA[i]) for i in range(4)]
+    x += [s[3], s[2], s[1], s[0]]
+    x += [zero] * 4
+    x += [zero, zero + np.uint32(pos_word), zero, zero]
+    init = list(x)
+    for _ in range(6):
+        for (a, b, c, d) in ((0, 4, 8, 12), (1, 5, 9, 13),
+                             (2, 6, 10, 14), (3, 7, 11, 15),
+                             (0, 5, 10, 15), (1, 6, 11, 12),
+                             (2, 7, 8, 13), (3, 4, 9, 14)):
+            x[a] = x[a] + x[b]
+            x[d] = _rotl(x[d] ^ x[a], 16)
+            x[c] = x[c] + x[d]
+            x[b] = _rotl(x[b] ^ x[c], 12)
+            x[a] = x[a] + x[b]
+            x[d] = _rotl(x[d] ^ x[a], 8)
+            x[c] = x[c] + x[d]
+            x[b] = _rotl(x[b] ^ x[c], 7)
+    return [x[7] + init[7], x[6] + init[6], x[5] + init[5], x[4] + init[4]]
 
-    sel = (s[0] & np.uint32(1)).astype(jnp.bool_)   # [TB, TW]
+
+def _salsa_core_planes(s, pos_word):
+    """Salsa20-12 core on 4 seed planes — layout matches
+    ``core/prf._salsa_state`` (key at words 4..1 LSW-last, pos at word 9,
+    output words 4..1 -> limbs LSW-first)."""
+    zero = s[0] - s[0]
+    x = [zero] * 16
+    x[0] = zero + np.uint32(_SIGMA[0])
+    x[5] = zero + np.uint32(_SIGMA[1])
+    x[10] = zero + np.uint32(_SIGMA[2])
+    x[15] = zero + np.uint32(_SIGMA[3])
+    x[1], x[2], x[3], x[4] = s[3], s[2], s[1], s[0]
+    x[9] = zero + np.uint32(pos_word)
+    init = list(x)
+    for _ in range(6):
+        for (a, b, c, d) in ((0, 4, 8, 12), (5, 9, 13, 1), (10, 14, 2, 6),
+                             (15, 3, 7, 11), (0, 1, 2, 3), (5, 6, 7, 4),
+                             (10, 11, 8, 9), (15, 12, 13, 14)):
+            x[b] = x[b] ^ _rotl(x[a] + x[d], 7)
+            x[c] = x[c] ^ _rotl(x[b] + x[a], 9)
+            x[d] = x[d] ^ _rotl(x[c] + x[b], 13)
+            x[a] = x[a] ^ _rotl(x[d] + x[c], 18)
+    return [x[4] + init[4], x[3] + init[3], x[2] + init[2], x[1] + init[1]]
+
+
+_CORES = {2: _chacha_core_planes, 1: _salsa_core_planes}  # prf id -> core
+
+
+def _add128_planes(val, cw):
+    """val + cw mod 2^128 on two 4-plane lists (explicit carry chain)."""
+    out = []
+    carry = None
+    for i in range(4):
+        t = val[i] + cw[i]
+        c1 = (t < val[i]).astype(jnp.uint32)
+        if carry is None:
+            out.append(t)
+            carry = c1
+        else:
+            t2 = t + carry
+            c2 = (t2 < t).astype(jnp.uint32)
+            out.append(t2)
+            carry = c1 | c2
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Tiled single level step
+# ---------------------------------------------------------------------------
+
+def _level_kernel(seeds_ref, cw1_ref, cw2_ref, out0_ref, out1_ref):
+    """seeds [4, TB, TW] u32; cw* [4, TB, 2] (limb, key, branch);
+    out* [4, TB, TW] — children for branches 0 and 1."""
+    s = [seeds_ref[i] for i in range(4)]
+    sel = (s[0] & np.uint32(1)).astype(jnp.bool_)
     for branch, out_ref in ((0, out0_ref), (1, out1_ref)):
-        val = core(np.uint32(branch))
-        carry = None
+        val = _chacha_core_planes(s, np.uint32(branch))
+        cw = [jnp.where(sel, cw2_ref[i, :, branch][:, None],
+                        cw1_ref[i, :, branch][:, None]) for i in range(4)]
+        res = _add128_planes(val, cw)
         for i in range(4):
-            cw_i = jnp.where(sel, cw2_ref[i, :, branch][:, None],
-                             cw1_ref[i, :, branch][:, None])
-            t = val[i] + cw_i
-            c1 = (t < val[i]).astype(jnp.uint32)
-            if carry is None:
-                out_ref[i] = t
-                carry = c1
-            else:
-                t2 = t + carry
-                c2 = (t2 < t).astype(jnp.uint32)
-                out_ref[i] = t2
-                carry = c1 | c2
+            out_ref[i] = res[i]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def chacha_level_step_pallas(seeds, cw1_lvl, cw2_lvl, interpret=False):
-    """One ChaCha GGM level via Pallas.
+@functools.partial(jax.jit, static_argnames=("interpret", "tb", "tw"))
+def chacha_level_step_pallas(seeds, cw1_lvl, cw2_lvl, interpret=False,
+                             tb: int = 8, tw: int = 512):
+    """One ChaCha GGM level via Pallas, tiled over (batch, width).
 
     seeds: [B, w, 4] u32; cw*_lvl: [B, 2, 4] u32 (this level's codeword
     pair per key).  Returns [B, 2w, 4] children (new[2j+b] layout).
+    VMEM per step is bounded by the (tb, tw) tile regardless of B, w.
     """
     from jax.experimental import pallas as pl
 
     bsz, w, _ = seeds.shape
-    sm = jnp.transpose(seeds, (2, 0, 1))            # [4, B, w]
-    cw1 = jnp.transpose(cw1_lvl, (2, 0, 1))         # [4, B, 2]
+    tb = min(tb, bsz)
+    tw = min(tw, w)
+    if bsz % tb or w % tw:  # pad to tile multiples, slice after
+        pb = (-bsz) % tb
+        pw = (-w) % tw
+        seeds = jnp.pad(seeds, ((0, pb), (0, pw), (0, 0)))
+        cw1_lvl = jnp.pad(cw1_lvl, ((0, pb), (0, 0), (0, 0)))
+        cw2_lvl = jnp.pad(cw2_lvl, ((0, pb), (0, 0), (0, 0)))
+    bp, wp = seeds.shape[0], seeds.shape[1]
+
+    sm = jnp.transpose(seeds, (2, 0, 1))     # [4, B, w]
+    cw1 = jnp.transpose(cw1_lvl, (2, 0, 1))  # [4, B, 2]
     cw2 = jnp.transpose(cw2_lvl, (2, 0, 1))
 
-    out_shape = [jax.ShapeDtypeStruct((4, bsz, w), jnp.uint32)] * 2
+    grid = (bp // tb, wp // tw)
+    out_shape = [jax.ShapeDtypeStruct((4, bp, wp), jnp.uint32)] * 2
+    spec_seeds = pl.BlockSpec((4, tb, tw), lambda i, j: (0, i, j))
+    spec_cw = pl.BlockSpec((4, tb, 2), lambda i, j: (0, i, 0))
+    spec_out = pl.BlockSpec((4, tb, tw), lambda i, j: (0, i, j))
     out0, out1 = pl.pallas_call(
-        _chacha_pair_kernel,
+        _level_kernel,
+        grid=grid,
+        in_specs=[spec_seeds, spec_cw, spec_cw],
+        out_specs=[spec_out, spec_out],
         out_shape=out_shape,
         interpret=interpret,
     )(sm, cw1, cw2)
 
     children = jnp.stack([jnp.transpose(out0, (1, 2, 0)),
                           jnp.transpose(out1, (1, 2, 0))], axis=2)
-    return children.reshape(bsz, 2 * w, 4)
+    return children.reshape(bp, 2 * wp, 4)[:bsz, :2 * w]
+
+
+# ---------------------------------------------------------------------------
+# Fused subtree expand + contract (the production kernel)
+# ---------------------------------------------------------------------------
+
+def _make_subtree_kernel(levels: int, core=_chacha_core_planes):
+    from jax.experimental import pallas as pl
+
+    def kernel(seeds_ref, cw1_ref, cw2_ref, table_ref, out_ref):
+        f = pl.program_id(1)
+        planes = [seeds_ref[i] for i in range(4)]     # [TB, 1]
+        for k in range(levels):
+            sel = (planes[0] & np.uint32(1)).astype(jnp.bool_)  # [TB, w]
+            children = []
+            for b in (0, 1):
+                val = core(planes, np.uint32(b))
+                cw = [jnp.where(sel, cw2_ref[i, :, 2 * k + b][:, None],
+                                cw1_ref[i, :, 2 * k + b][:, None])
+                      for i in range(4)]
+                children.append(_add128_planes(val, cw))
+            w = planes[0].shape[1]
+            planes = [jnp.stack([children[0][i], children[1][i]],
+                                axis=2).reshape(-1, 2 * w)
+                      for i in range(4)]
+        leaves = planes[0].astype(jnp.int32)          # [TB, C]
+        contrib = lax.dot_general(
+            leaves, table_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)         # x [E, C] -> [TB, E]
+
+        @pl.when(f == 0)
+        def _():
+            out_ref[:] = contrib
+
+        @pl.when(f > 0)
+        def _():
+            out_ref[:] = out_ref[:] + contrib
+
+    return kernel
+
+
+# default tile knobs: widest level state = 16 words x [TB, C/2] u32
+PALLAS_TB = 32       # key tile (sublane-friendly multiple of 8)
+PALLAS_MAX_C = 4096  # leaves per subtree -> ~4 MB cipher state in VMEM
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "depth", "f_levels", "interpret", "tb", "prf_method"))
+def subtree_contract_pallas(frontier, cw1, cw2, table_perm, *,
+                            depth: int, f_levels: int,
+                            interpret=False, tb: int | None = None,
+                            prf_method: int = 2):
+    """Fused phase-2: expand every frontier subtree in VMEM and contract.
+
+    frontier:   [B, F, 4] u32 — phase-1 output seeds (subtree f of key b).
+    cw1, cw2:   [B, 64, 4] u32 — full codeword arrays (wire layout).
+    table_perm: [N, E] int32 — bit-reverse-permuted table, N = F * C.
+    prf_method: 2 = ChaCha20-12, 1 = Salsa20-12.
+    Returns [B, E] int32 shares: sum_f leaves(f) . chunk(f).
+    """
+    from jax.experimental import pallas as pl
+
+    bsz, f_cnt, _ = frontier.shape
+    n, e = table_perm.shape
+    c = n // f_cnt
+    levels = depth - f_levels
+    assert c == 1 << levels, (c, levels)
+
+    tb = tb or min(PALLAS_TB, max(8, bsz))
+    pb = (-bsz) % tb
+    if pb:
+        frontier = jnp.pad(frontier, ((0, pb), (0, 0), (0, 0)))
+        cw1 = jnp.pad(cw1, ((0, pb), (0, 0), (0, 0)))
+        cw2 = jnp.pad(cw2, ((0, pb), (0, 0), (0, 0)))
+    bp = bsz + pb
+
+    # phase-2 codeword slots, kernel level k = global flat level
+    # depth-1-(f_levels+k), branches adjacent: [4, B, 2*levels]
+    idx = np.array([2 * (depth - 1 - (f_levels + k)) + b
+                    for k in range(levels) for b in (0, 1)])
+    cw1_sl = jnp.transpose(cw1[:, idx, :], (2, 0, 1))
+    cw2_sl = jnp.transpose(cw2[:, idx, :], (2, 0, 1))
+    seeds = jnp.transpose(frontier, (2, 0, 1))        # [4, B, F]
+    table_t = table_perm.T                            # [E, N]
+
+    grid = (bp // tb, f_cnt)
+    kernel = _make_subtree_kernel(levels, _CORES[prf_method])
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((4, tb, 1), lambda i, f: (0, i, f)),
+            pl.BlockSpec((4, tb, 2 * levels), lambda i, f: (0, i, 0)),
+            pl.BlockSpec((4, tb, 2 * levels), lambda i, f: (0, i, 0)),
+            pl.BlockSpec((e, c), lambda i, f: (0, f)),
+        ],
+        out_specs=pl.BlockSpec((tb, e), lambda i, f: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, e), jnp.int32),
+        interpret=interpret,
+    )(seeds, cw1_sl, cw2_sl, table_t)
+    return out[:bsz]
+
+
+def pallas_chunk_leaves(n: int) -> int:
+    """Leaves per subtree for the Pallas path.  Unlike the XLA path's
+    ``choose_chunk`` (which scales with batch), the bound here is the
+    per-key-tile VMEM cipher state, fixed by (PALLAS_TB, PALLAS_MAX_C)."""
+    c = 1
+    while c * 2 <= min(n, PALLAS_MAX_C):
+        c *= 2
+    return c

@@ -15,7 +15,8 @@ import numpy as np
 
 
 def test_dpf_perf(N=16384, batch=512, entrysize=16, prf=None, reps=10,
-                  keys_distinct=None, quiet=False, check=False):
+                  keys_distinct=None, quiet=False, check=False,
+                  config=None, dispatch_deadline=None):
     """Measure batched eval throughput; returns the result dict.
 
     Every key in the measured batch is a distinct real key by default
@@ -29,7 +30,8 @@ def test_dpf_perf(N=16384, batch=512, entrysize=16, prf=None, reps=10,
     """
     from ..api import DPF
 
-    dpf = DPF(prf=prf)
+    dpf = DPF(prf=prf, config=config)
+    dpf.dispatch_deadline = dispatch_deadline
     if keys_distinct is None:
         keys_distinct = batch
     # odd multiplier is bijective mod the pow2 table size: indices are
@@ -39,8 +41,10 @@ def test_dpf_perf(N=16384, batch=512, entrysize=16, prf=None, reps=10,
     ks = [p[0] for p in pairs]
     keys = [ks[i % keys_distinct] for i in range(batch)]
 
-    table = np.random.randint(0, 2 ** 31, (N, entrysize),
-                              dtype=np.int64).astype(np.int32)
+    # generate directly at int32 width (an int64 intermediate would be an
+    # 8.6 GB transient at the large-table sweep's N=2^26)
+    table = np.random.default_rng(1).integers(
+        0, 2 ** 31, (N, entrysize), dtype=np.int32, endpoint=False)
     dpf.eval_init(table)
 
     if check:
@@ -72,15 +76,16 @@ def test_dpf_perf(N=16384, batch=512, entrysize=16, prf=None, reps=10,
     return result
 
 
-def test_dpf_latency(N=16384, entrysize=16, prf=None, reps=20, quiet=False):
+def test_dpf_latency(N=16384, entrysize=16, prf=None, reps=20, quiet=False,
+                     config=None):
     """Single-query latency (the reference's latency benchmark mode,
     ``dpf_benchmark.cu:242-276``): one key, one dispatch, wall-clock ms."""
     from ..api import DPF
 
-    dpf = DPF(prf=prf)
+    dpf = DPF(prf=prf, config=config)
     k1, _ = dpf.gen(N // 3, N)
-    table = np.random.randint(0, 2 ** 31, (N, entrysize),
-                              dtype=np.int64).astype(np.int32)
+    table = np.random.default_rng(1).integers(
+        0, 2 ** 31, (N, entrysize), dtype=np.int32, endpoint=False)
     dpf.eval_init(table)
     dpf.eval_tpu([k1])  # compile + warm
     t0 = time.time()

@@ -16,7 +16,9 @@ class EvalConfig:
     chunk_leaves: int | None = None  # None = auto (choose_chunk)
     dot_impl: str = "i32"          # "i32" | "mxu" (ops/matmul128)
     round_unroll: bool | None = None  # None = auto (unroll on TPU)
-    aes_impl: str = "auto"         # "auto" | "gather" | "bitsliced"
+    aes_impl: str = "auto"  # "auto"|"gather"|"bitsliced"[":bp"|":tower"]
+    kernel_impl: str = "xla"  # "xla" | "pallas" (ChaCha/Salsa subtree
+    #                  kernel) | "dispatch" (per-level programs; fast compile)
 
     def with_(self, **kw) -> "EvalConfig":
         return replace(self, **kw)

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Incremental TPU diagnostic: time compile vs run for each eval config.
+
+The round-1/2 headline bench hit its watchdog while the relay answered
+small programs quickly — this isolates whether the cost is XLA compile
+time (graph size), device runtime, or the relay.  Prints one flushed
+result line per stage so a wedge is attributable to a specific stage.
+
+  python experiments/tpu_diag.py [--skip N]   # skip the first N stages
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def stage(name, fn):
+    t0 = time.time()
+    try:
+        out = fn()
+        dt = time.time() - t0
+        print(json.dumps({"stage": name, "ok": True,
+                          "elapsed_s": round(dt, 2),
+                          "extra": out if isinstance(out, dict) else None}),
+              flush=True)
+    except Exception as e:
+        dt = time.time() - t0
+        print(json.dumps({"stage": name, "ok": False,
+                          "elapsed_s": round(dt, 2),
+                          "error": "%s: %s" % (type(e).__name__,
+                                               str(e)[:200])}), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--skip", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    stage("devices", lambda: {"devices": str(jax.devices())})
+    stage("tiny_matmul", lambda: float(
+        (jnp.ones((256, 256)) @ jnp.ones((256, 256))).sum()))
+
+    import dpf_tpu
+    from dpf_tpu.utils.bench import test_dpf_perf
+    from dpf_tpu.utils.config import EvalConfig
+
+    def perf(prf, n, batch, reps, **knobs):
+        cfg = EvalConfig(prf_method=prf, batch_size=batch, **knobs)
+        cfg.apply_globals()
+        r = test_dpf_perf(N=n, batch=batch, prf=prf, reps=reps, quiet=True,
+                          keys_distinct=8, config=cfg)
+        return {"dpfs_per_sec": r["dpfs_per_sec"],
+                "elapsed_s": r["elapsed_s"]}
+
+    stages = [
+        # (name, thunk) — relay-safe ordering: dispatch mode (per-level
+        # programs) before any monolithic graph; no monolithic bitsliced
+        # AES at all (its compile can outlive any patience via the relay
+        # and killing it mid-compile wedges the relay — docs/STATUS.md)
+        ("dummy_n16k", lambda: perf(dpf_tpu.PRF_DUMMY, 16384, 64, 2)),
+        ("chacha_n16k_disp", lambda: perf(dpf_tpu.PRF_CHACHA20, 16384, 64,
+                                          2, kernel_impl="dispatch")),
+        ("aes_bitsliced_n16k_disp", lambda: perf(
+            dpf_tpu.PRF_AES128, 16384, 128, 2, aes_impl="bitsliced:bp",
+            round_unroll=False, kernel_impl="dispatch")),
+        ("aes_bitsliced_n64k_b512_disp", lambda: perf(
+            dpf_tpu.PRF_AES128, 65536, 512, 3, aes_impl="bitsliced:bp",
+            round_unroll=False, kernel_impl="dispatch")),
+        ("chacha_n64k_b512_loop", lambda: perf(dpf_tpu.PRF_CHACHA20, 65536,
+                                               512, 3, round_unroll=False)),
+        ("chacha_n64k_b512_unroll", lambda: perf(dpf_tpu.PRF_CHACHA20,
+                                                 65536, 512, 3,
+                                                 round_unroll=True)),
+        ("chacha_n64k_b512_pallas", lambda: perf(
+            dpf_tpu.PRF_CHACHA20, 65536, 512, 3, kernel_impl="pallas")),
+        ("aes_gather_n16k_loop", lambda: perf(dpf_tpu.PRF_AES128, 16384, 64,
+                                              2, aes_impl="gather",
+                                              round_unroll=False)),
+    ]
+    for i, (name, fn) in enumerate(stages):
+        if i < args.skip:
+            continue
+        stage(name, fn)
+
+
+if __name__ == "__main__":
+    main()

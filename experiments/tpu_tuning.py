@@ -27,6 +27,11 @@ def main():
     ap.add_argument("--out", default="tpu_tuning.json")
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--entries", type=int, default=None)
+    ap.add_argument("--risky", action="store_true",
+                    help="also measure monolithic bitsliced-AES programs "
+                         "(compile may take tens of minutes via the relay "
+                         "and MUST NOT be hard-killed mid-compile — see "
+                         "docs/STATUS.md)")
     args = ap.parse_args()
 
     import dpf_tpu
@@ -44,23 +49,41 @@ def main():
         cfg.apply_globals()
         try:
             r = test_dpf_perf(N=n, batch=batch, prf=prf, reps=reps,
-                              quiet=True)
+                              quiet=True, config=cfg)
         except Exception as e:  # record failures, keep sweeping
-            r = {"error": str(e)[:200], "dpfs_per_sec": 0}
+            r = {"error": str(e)[:200], "dpfs_per_sec": 0,
+                 "prf": {1: "SALSA20", 2: "CHACHA20", 3: "AES128"}.get(
+                     prf, str(prf))}
         r.update({"knobs": knobs, "prf_id": prf})
         results.append(r)
-        print(json.dumps(r))
+        print(json.dumps(r), flush=True)
         return r["dpfs_per_sec"]
 
-    # AES: the headline; all knob combos
-    for aes_impl, unroll, dot in itertools.product(
-            ("gather", "bitsliced"), (False, True), ("i32", "mxu")):
+    # Ordered safest-compile first so a relay wedge late in the run
+    # cannot erase earlier results (every point prints immediately).
+    # AES headline: dispatch mode (per-level programs) x S-box x unroll
+    for aes_impl, unroll in itertools.product(
+            ("bitsliced:bp", "bitsliced:tower", "gather"), (False, True)):
         measure(dpf_tpu.PRF_AES128, aes_impl=aes_impl, round_unroll=unroll,
-                dot_impl=dot)
-    # ChaCha/Salsa: unroll x dot
-    for prf in (dpf_tpu.PRF_CHACHA20, dpf_tpu.PRF_SALSA20):
-        for unroll, dot in itertools.product((False, True), ("i32", "mxu")):
-            measure(prf, round_unroll=unroll, dot_impl=dot)
+                kernel_impl="dispatch")
+    # ChaCha: xla scan (small graphs; round-1-proven compile) x unroll
+    # x dot, dispatch mode, then the Pallas subtree kernel
+    for unroll, dot in itertools.product((False, True), ("i32", "mxu")):
+        measure(dpf_tpu.PRF_CHACHA20, kernel_impl="xla",
+                round_unroll=unroll, dot_impl=dot)
+    measure(dpf_tpu.PRF_CHACHA20, kernel_impl="dispatch")
+    measure(dpf_tpu.PRF_CHACHA20, kernel_impl="pallas")
+    # Salsa: unroll x dot
+    for unroll, dot in itertools.product((False, True), ("i32", "mxu")):
+        measure(dpf_tpu.PRF_SALSA20, round_unroll=unroll, dot_impl=dot)
+    # AES monolithic (gather first — ~100 s compile in round 1; bitsliced
+    # monolithic only with --risky)
+    measure(dpf_tpu.PRF_AES128, aes_impl="gather", round_unroll=False)
+    if args.risky:
+        for aes_impl, unroll in itertools.product(
+                ("bitsliced:bp", "bitsliced:tower"), (False, True)):
+            measure(dpf_tpu.PRF_AES128, aes_impl=aes_impl,
+                    round_unroll=unroll)
 
     best = {}
     for r in results:

@@ -1,9 +1,9 @@
-"""Pallas level-step kernel vs the portable path.
+"""Pallas expansion kernels vs the portable XLA path.
 
 Interpret mode costs ~30 s per pallas_call on CPU regardless of size
-(per-op interpreter overhead), so the default suite runs one minimal case;
-set DPF_RUN_SLOW=1 for the wider-shape case.  On TPU the same kernel
-compiles for real (see experiments/tpu_tuning.py for the A/B).
+(per-op interpreter overhead), so the default suite runs minimal cases;
+set DPF_RUN_SLOW=1 for wider shapes.  On TPU the same kernels compile for
+real (see experiments/tpu_tuning.py and utils/bench.py for the A/B).
 """
 
 import os
@@ -15,13 +15,19 @@ import jax.numpy as jnp
 
 from dpf_tpu.core import expand, keygen
 
+SLOW = bool(os.environ.get("DPF_RUN_SLOW"))
 
-def _case(width_levels, n_keys=1):
-    from dpf_tpu.ops import pallas_level
-    n, method = 512, 2  # ChaCha20
+
+def _keys(n, n_keys, method=2):
     flat = [keygen.generate_keys((i * 131) % n, n, b"plv%d" % i, method)[0]
             for i in range(n_keys)]
-    cw1, cw2, last = expand.pack_keys(flat)
+    return expand.pack_keys(flat)
+
+
+def _level_case(width_levels, n_keys=1):
+    from dpf_tpu.ops import pallas_level
+    n, method = 512, 2  # ChaCha20
+    cw1, cw2, last = _keys(n, n_keys)
     depth = 9
     seeds = jnp.asarray(last)[:, None, :]
     for l in range(width_levels):
@@ -32,16 +38,83 @@ def _case(width_levels, n_keys=1):
                               i, method)
     got = pallas_level.chacha_level_step_pallas(
         seeds, jnp.asarray(cw1[:, 2 * i:2 * i + 2, :]),
-        jnp.asarray(cw2[:, 2 * i:2 * i + 2, :]), interpret=True)
+        jnp.asarray(cw2[:, 2 * i:2 * i + 2, :]), interpret=True,
+        tb=4, tw=2)
     assert (np.asarray(got) == np.asarray(want)).all()
 
 
 def test_pallas_chacha_level_matches_portable():
-    _case(0)
+    _level_case(0)
 
 
-@pytest.mark.skipif(not os.environ.get("DPF_RUN_SLOW"),
+@pytest.mark.skipif(not SLOW,
                     reason="interpret-mode cost grows steeply with shape; "
                            "set DPF_RUN_SLOW=1 (or run compiled on TPU)")
 def test_pallas_chacha_level_wider():
-    _case(2, n_keys=2)
+    _level_case(2, n_keys=2)
+
+
+def _subtree_case(n, n_keys, chunk, tb=None, method=2):
+    """Fused subtree kernel (interpret) vs the XLA scan path, end to end."""
+    depth = n.bit_length() - 1
+    cw1, cw2, last = _keys(n, n_keys, method)
+    rng = np.random.default_rng(5)
+    table = rng.integers(-2 ** 31, 2 ** 31, (n, 16), dtype=np.int32)
+    tperm = jnp.asarray(expand.permute_table(table))
+    want = expand.expand_and_contract(
+        cw1, cw2, last, tperm, depth=depth, prf_method=method,
+        chunk_leaves=chunk)
+    f = n // chunk
+    f_levels = int(np.log2(f))
+    seeds = jnp.asarray(last)[:, None, :]
+    for l in range(f_levels):
+        seeds = expand._level_step(seeds, jnp.asarray(cw1),
+                                   jnp.asarray(cw2), depth - 1 - l, method)
+    from dpf_tpu.ops import pallas_level
+    got = pallas_level.subtree_contract_pallas(
+        seeds, jnp.asarray(cw1), jnp.asarray(cw2), tperm, depth=depth,
+        f_levels=f_levels, interpret=True, tb=tb, prf_method=method)
+    assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_pallas_subtree_contract_minimal():
+    # 2 subtrees of 64 leaves, 2 keys (padded to one tile of 8)
+    _subtree_case(128, 2, 64)
+
+
+def test_pallas_subtree_contract_salsa():
+    _subtree_case(128, 2, 64, method=1)
+
+
+@pytest.mark.skipif(not SLOW, reason="interpret mode; DPF_RUN_SLOW=1")
+def test_pallas_subtree_contract_wider():
+    # several key tiles and frontier nodes
+    _subtree_case(1024, 10, 128, tb=8)
+
+
+@pytest.mark.skipif(not SLOW, reason="interpret mode; DPF_RUN_SLOW=1")
+def test_pallas_full_path_via_config(monkeypatch):
+    """kernel_impl='pallas' through the real DPF API: exercises the
+    api.py branch (pallas_chunk_leaves selection + threading into
+    expand_and_contract).  The Mosaic kernel itself runs in interpret
+    mode on CPU via a monkeypatched wrapper."""
+    import dpf_tpu
+    from dpf_tpu.ops import pallas_level
+    from dpf_tpu.utils.config import EvalConfig
+
+    orig = pallas_level.subtree_contract_pallas
+    monkeypatch.setattr(
+        pallas_level, "subtree_contract_pallas",
+        lambda *a, **kw: orig(*a, **{**kw, "interpret": True}))
+
+    n = 256
+    cfg = EvalConfig(prf_method=dpf_tpu.PRF_CHACHA20, kernel_impl="pallas")
+    d = dpf_tpu.DPF(config=cfg)
+    ref = dpf_tpu.DPF(prf=dpf_tpu.PRF_CHACHA20)
+    table = np.arange(n * 4, dtype=np.int32).reshape(n, 4)
+    d.eval_init(table)
+    ref.eval_init(table)
+    keys = [d.gen(7, n)[0], d.gen(200, n)[1]]
+    got = np.asarray(d.eval_tpu(keys))
+    want = np.asarray(ref.eval_tpu(keys))
+    assert (got == want).all()

@@ -1,0 +1,88 @@
+"""Boyar-Peralta S-box circuit tests (dpf_tpu/core/aes_sbox_bp)."""
+
+import numpy as np
+
+from dpf_tpu.core import aes_bitsliced, aes_sbox_bp as bp, prf_ref
+from dpf_tpu.core import aes_sbox_circuit as asc
+
+
+def _planes_for(vals):
+    bits = [np.where((vals >> b) & 1 == 1, np.uint32(0xFFFFFFFF),
+                     np.uint32(0)) for b in range(8)]
+    ones = np.full_like(vals, 0xFFFFFFFF)
+    return bits, ones
+
+
+def _collect(bits):
+    out = np.zeros_like(bits[0])
+    for b in range(8):
+        out |= (bits[b] & 1) << b
+    return out
+
+
+def test_bp_sbox_all_256():
+    vals = np.arange(256, dtype=np.uint32)
+    bits, ones = _planes_for(vals)
+    got = _collect(bp.sbox_bits_bp(bits, ones))
+    want = np.array(prf_ref.SBOX, dtype=np.uint32)
+    assert (got == want).all()
+
+
+def test_bp_matches_tower_circuit():
+    """Independently derived circuits must agree everywhere."""
+    vals = np.arange(256, dtype=np.uint32)
+    bits, ones = _planes_for(vals)
+    got_bp = _collect(bp.sbox_bits_bp(bits, ones))
+    tower = _collect(asc.sbox_bits_tower(bits, ones))
+    assert (got_bp == tower).all()
+
+
+def test_bp_dispatch_via_sbox_bits():
+    vals = np.arange(256, dtype=np.uint32)
+    bits, ones = _planes_for(vals)
+    want = np.array(prf_ref.SBOX, dtype=np.uint32)
+    for impl in ("bp", "tower", "chain"):
+        got = _collect(aes_bitsliced._sbox_bits(bits, ones, impl))
+        assert (got == want).all(), impl
+    # module default is the BP circuit
+    assert aes_bitsliced.SBOX_IMPL == "bp"
+
+
+def test_bp_circuit_is_smallest():
+    """Symbolic plane-op count: bp < tower < chain."""
+    ops = {"bp": 0, "tower": 0, "chain": 0}
+
+    class Rec:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def __xor__(self, other):
+            ops[self.tag] += 1
+            return self
+
+        __and__ = __xor__
+
+    for tag, fn in (("bp", bp.sbox_bits_bp),
+                    ("tower", asc.sbox_bits_tower),
+                    ("chain", aes_bitsliced._sbox_bits_chain)):
+        bits = [Rec(tag) for _ in range(8)]
+        fn(bits, Rec(tag))
+    assert ops["bp"] < ops["tower"] < ops["chain"], ops
+    assert ops["bp"] == bp.N_OPS  # documented count matches the trace
+    assert ops["bp"] <= 130  # ~120: 23 top + 44 middle + 18 AND + ~35 XOR
+
+
+def test_bitsliced_aes_with_bp_sbox_kats():
+    """Full bitsliced AES with each S-box impl matches the scalar
+    reference PRF for both GGM positions."""
+    from dpf_tpu.core import u128
+
+    rng = np.random.default_rng(7)
+    seeds = rng.integers(0, 2 ** 32, (64, 4), dtype=np.uint32)
+    ints = u128.limbs_to_ints(seeds)
+    want0 = [prf_ref.prf_aes128(int(s), 0) for s in ints]
+    want1 = [prf_ref.prf_aes128(int(s), 1) for s in ints]
+    for impl in ("bp", "tower"):
+        out0, out1 = aes_bitsliced.aes128_pair_bitsliced(seeds, sbox=impl)
+        assert list(u128.limbs_to_ints(out0)) == want0, impl
+        assert list(u128.limbs_to_ints(out1)) == want1, impl
