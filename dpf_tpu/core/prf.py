@@ -1,9 +1,10 @@
 """Vectorized PRFs over [..., 4]-uint32 limb arrays — the TPU hot path.
 
 Each function maps a batch of 128-bit seeds (trailing axis = 4 little-endian
-uint32 limbs) and a *static* small position ``pos`` (0 or 1 in the GGM walk)
-to a batch of 128-bit PRF outputs, matching the scalar semantics in
-``prf_ref.py`` bit-for-bit.
+uint32 limbs) and a position ``pos`` — a static small int (0 or 1 in the
+GGM walk) or a traced uint32 array broadcastable against the batch (the
+sqrt-N grid eval) — to a batch of 128-bit PRF outputs, matching the scalar
+semantics in ``prf_ref.py`` bit-for-bit.
 
 The implementations are backend generic (NumPy for the host reference path,
 jax.numpy inside jit for TPU): Salsa/ChaCha are pure 32-bit add/xor/rotate
@@ -32,15 +33,29 @@ def _rotl(x, b: int):
 # DUMMY
 # ---------------------------------------------------------------------------
 
-def prf_dummy_v(seeds, pos: int):
+def _pos_word(zero, pos, word: int):
+    """32-bit word `word` of the 128-bit position, broadcast like `zero`.
+
+    `pos` is either a static Python int (the GGM branch/pos constants) or
+    a traced uint32 array of row indices (< 2^32 — the sqrt-N grid eval),
+    in which case only word 0 is nonzero.
+    """
+    if isinstance(pos, (int, np.integer)):
+        return zero + np.uint32((int(pos) >> (32 * word)) & 0xFFFFFFFF)
+    return zero + pos if word == 0 else zero
+
+
+def prf_dummy_v(seeds, pos):
     """seed * (pos+4242) + (pos+4242) mod 2^128, vectorized."""
-    t = pos + 4242
-    r = u128.mul128_small(seeds, t)
-    tl = np.array(u128.int_to_limbs(t))
-    # broadcast the constant to the seed batch shape via zero-add
     zero = seeds - seeds
-    tb = zero + tl
-    return u128.add128(r, tb)
+    if isinstance(pos, (int, np.integer)):
+        t = int(pos) + 4242
+        tb = zero + np.array(u128.int_to_limbs(t))
+        return u128.add128(u128.mul128_small(seeds, t), tb)
+    t32 = pos + np.uint32(4242)  # row indices < 2^32 - 4242
+    tb = u128._stack_last([zero[..., 0] + t32] + [zero[..., i]
+                                                 for i in range(1, 4)])
+    return u128.add128(u128.mul128_small(seeds, t32), tb)
 
 
 # ---------------------------------------------------------------------------
@@ -67,8 +82,8 @@ def prf_salsa20_12_v(seeds, pos: int):
     x[2] = seeds[..., 2]
     x[3] = seeds[..., 1]
     x[4] = seeds[..., 0]
-    x[8] = zero + np.uint32((pos >> 32) & 0xFFFFFFFF)
-    x[9] = zero + np.uint32(pos & 0xFFFFFFFF)
+    x[8] = _pos_word(zero, pos, 1)
+    x[9] = _pos_word(zero, pos, 0)
     init = list(x)
     for _ in range(6):
         _salsa_qr(x, 0, 4, 8, 12)
@@ -107,8 +122,8 @@ def prf_chacha20_12_v(seeds, pos: int):
     x[5] = seeds[..., 2]
     x[6] = seeds[..., 1]
     x[7] = seeds[..., 0]
-    x[12] = zero + np.uint32((pos >> 32) & 0xFFFFFFFF)
-    x[13] = zero + np.uint32(pos & 0xFFFFFFFF)
+    x[12] = _pos_word(zero, pos, 1)
+    x[13] = _pos_word(zero, pos, 0)
     init = list(x)
     for _ in range(6):
         _chacha_qr(x, 0, 4, 8, 12)
@@ -173,6 +188,16 @@ def _xtime_v(b):
     return d & np.uint32(0xFF)
 
 
+def _pos_bytes(zero, pos):
+    """16 LE plaintext byte planes of the position (int or uint32 array)."""
+    if isinstance(pos, (int, np.integer)):
+        pt = (int(pos) & ((1 << 128) - 1)).to_bytes(16, "little")
+        return [zero + np.uint32(b) for b in pt]
+    lo = [zero + ((pos >> np.uint32(8 * k)) & np.uint32(0xFF))
+          for k in range(4)]
+    return lo + [zero] * 12
+
+
 def prf_aes128_v(seeds, pos: int):
     """FIPS-197 AES-128 per seed: key = seed LE bytes, pt = pos LE bytes.
 
@@ -183,8 +208,7 @@ def prf_aes128_v(seeds, pos: int):
     kb = _bytes_of_limbs(seeds)  # [..., 16] key bytes
     rk = [kb[..., i] for i in range(16)]
     zero = seeds[..., 0] - seeds[..., 0]
-    pt = (pos & ((1 << 128) - 1)).to_bytes(16, "little")
-    st = [zero + np.uint32(ptb) for ptb in pt]
+    st = _pos_bytes(zero, pos)
 
     def sub(v):
         return _take(_SBOX_NP, v)
@@ -261,8 +285,8 @@ def _salsa_state(seeds, pos: int):
     x[15] = zero + np.uint32(_SIGMA[3])
     x[1], x[2], x[3], x[4] = (seeds[..., 3], seeds[..., 2], seeds[..., 1],
                               seeds[..., 0])
-    x[8] = zero + np.uint32((pos >> 32) & 0xFFFFFFFF)
-    x[9] = zero + np.uint32(pos & 0xFFFFFFFF)
+    x[8] = _pos_word(zero, pos, 1)
+    x[9] = _pos_word(zero, pos, 0)
     return jnp.stack(x)
 
 
@@ -295,8 +319,8 @@ def _chacha_state(seeds, pos: int):
     x = [zero + np.uint32(_SIGMA[i]) for i in range(4)] + [zero] * 12
     x[4], x[5], x[6], x[7] = (seeds[..., 3], seeds[..., 2], seeds[..., 1],
                               seeds[..., 0])
-    x[12] = zero + np.uint32((pos >> 32) & 0xFFFFFFFF)
-    x[13] = zero + np.uint32(pos & 0xFFFFFFFF)
+    x[12] = _pos_word(zero, pos, 1)
+    x[13] = _pos_word(zero, pos, 0)
     return jnp.stack(x)
 
 
@@ -371,8 +395,7 @@ def prf_aes128_jax(seeds, pos: int, unroll: bool | None = None):
     kb = _bytes_of_limbs(seeds)
     rk = jnp.stack([kb[..., i] for i in range(16)])  # [16, ...]
     zero = seeds[..., 0] - seeds[..., 0]
-    pt = (pos & ((1 << 128) - 1)).to_bytes(16, "little")
-    st = jnp.stack([zero + np.uint32(b) for b in pt])
+    st = jnp.stack(_pos_bytes(zero, pos))
 
     rcon = jnp.asarray(_RCON)
 
@@ -420,8 +443,11 @@ PRF_V_JAX = {
 }
 
 
-def prf_v(method: int, seeds, pos: int, unroll: bool | None = None):
-    """Vectorized PRF dispatch; `method` and `pos` are static."""
+def prf_v(method: int, seeds, pos, unroll: bool | None = None):
+    """Vectorized PRF dispatch.  `method` is static; `pos` is a static
+    int (the GGM branch constants) OR a traced uint32 array of positions
+    broadcastable against the seed batch (the sqrt-N grid eval) — do not
+    mark `pos` as a jit static argument."""
     if isinstance(seeds, np.ndarray):
         return PRF_V_NUMPY[method](seeds, pos)
     if method == PRF_DUMMY:

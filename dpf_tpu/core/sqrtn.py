@@ -1,0 +1,157 @@
+"""Sqrt-N DPF construction: O(sqrt N) keys, single-level PRF evaluation.
+
+Re-derivation of the reference's non-recursive construction
+(``dpf_base/dpf.h:290-360``, the ``GenerateSeedsAndCodewords`` base case)
+as a standalone TPU-friendly scheme.  The table of N entries is viewed as
+an ``R x K`` grid (rows ``R = n_codewords``, columns ``K = n_keys``,
+index ``x = r * K + j``):
+
+* Each server holds K 128-bit column seeds, identical across servers
+  except at the target column ``j* = alpha % K``, where the two seeds are
+  random with forced-opposite LSBs (server 1 even, server 2 odd).
+* Both servers hold the same two codeword arrays ``cw1[R]``, ``cw2[R]``;
+  an evaluator adds ``cw1[r]`` or ``cw2[r]`` by the LSB of its column
+  seed.  ``cw2 - cw1 = PRF(s1, r) - PRF(s2, r) - beta*[r == r*]`` makes
+  the shares differ by ``beta`` exactly at ``alpha``.
+
+Compared with log-N keys (O(log N) size, O(N) PRFs tree-walked), sqrt-N
+keys are O(sqrt N) big but evaluation is a *flat* PRF grid — one
+vectorized PRF call over ``[R, K]`` (positions vary along rows: the PRF
+variants accept traced position arrays) plus one select/add.  On TPU that
+is one fused elementwise program with no level loop at all, so it's the
+latency-friendly construction for mid-sized tables, and the natural-order
+output needs no bit-reversal permutation.
+
+Keys use their own wire format (the reference never serializes sqrt keys;
+its wrapper ships log-N only): ``[K | R | n | alpha_pad | keys[K] |
+cw1[R] | cw2[R]]`` as uint128 little-endian slots viewed as int32.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import u128
+from .keygen import Shake256Drbg
+from .prf import prf_v
+from .prf_ref import MASK128, PRF_FUNCS
+
+
+@dataclass
+class SqrtKey:
+    """One server's sqrt-N DPF key (host representation)."""
+    n_keys: int          # K — column seeds
+    n_codewords: int     # R — rows (N = R * K)
+    n: int
+    keys: np.ndarray     # [K, 4] uint32 limbs
+    cw1: np.ndarray      # [R, 4] uint32
+    cw2: np.ndarray      # [R, 4] uint32
+
+    def serialize(self) -> np.ndarray:
+        k, r = self.n_keys, self.n_codewords
+        slots = np.zeros((4 + k + 2 * r, 4), dtype=np.uint32)
+        slots[0] = u128.int_to_limbs(k)
+        slots[1] = u128.int_to_limbs(r)
+        slots[2] = u128.int_to_limbs(self.n)
+        slots[4:4 + k] = self.keys
+        slots[4 + k:4 + k + r] = self.cw1
+        slots[4 + k + r:] = self.cw2
+        return slots.reshape(-1).view(np.int32).copy()
+
+
+def deserialize_sqrt_key(arr) -> SqrtKey:
+    flat = np.asarray(arr, dtype=np.int32).reshape(-1)
+    if flat.size % 4 or flat.size < 8:
+        raise ValueError("malformed sqrt-N key: %d int32 words" % flat.size)
+    slots = flat.view(np.uint32).reshape(-1, 4)
+    k = int(slots[0, 0])
+    r = int(slots[1, 0])
+    if slots.shape[0] != 4 + k + 2 * r:
+        raise ValueError("malformed sqrt-N key: %d slots for K=%d R=%d"
+                         % (slots.shape[0], k, r))
+    return SqrtKey(n_keys=k, n_codewords=r, n=u128.limbs_to_int(slots[2]),
+                   keys=slots[4:4 + k].copy(),
+                   cw1=slots[4 + k:4 + k + r].copy(),
+                   cw2=slots[4 + k + r:].copy())
+
+
+def default_split(n: int) -> tuple[int, int]:
+    """Balanced power-of-two grid: K = 2^ceil(d/2), R = N / K."""
+    d = n.bit_length() - 1
+    k = 1 << ((d + 1) // 2)
+    return k, n // k
+
+
+def generate_sqrt_keys(alpha: int, n: int, seed: bytes, prf_method: int,
+                       beta: int = 1, n_keys: int | None = None):
+    """-> (SqrtKey server1, SqrtKey server2) with share difference
+    ``v1[x] - v2[x] = beta * [x == alpha]`` mod 2^128."""
+    if n & (n - 1):
+        raise ValueError("n must be a power of two")
+    if not 0 <= alpha < n:
+        raise ValueError("alpha out of range")
+    k = n_keys or default_split(n)[0]
+    if n % k:
+        raise ValueError("n_keys must divide n")
+    r = n // k
+    j_t, r_t = alpha % k, alpha // k
+
+    rng = Shake256Drbg(seed)
+    keys1 = np.zeros((k, 4), dtype=np.uint32)
+    keys2 = np.zeros((k, 4), dtype=np.uint32)
+    for j in range(k):
+        if j == j_t:
+            keys1[j] = u128.int_to_limbs(rng.u128() & ~1)
+            keys2[j] = u128.int_to_limbs(rng.u128() | 1)
+        else:
+            keys1[j] = keys2[j] = u128.int_to_limbs(rng.u128())
+
+    prf = PRF_FUNCS[prf_method]
+    s1 = u128.limbs_to_int(keys1[j_t])
+    s2 = u128.limbs_to_int(keys2[j_t])
+    cw1 = np.zeros((r, 4), dtype=np.uint32)
+    cw2 = np.zeros((r, 4), dtype=np.uint32)
+    for row in range(r):
+        diff = (prf(s1, row) - prf(s2, row)) & MASK128
+        if row == r_t:
+            diff = (diff - beta) & MASK128
+        c1 = rng.u128()
+        cw1[row] = u128.int_to_limbs(c1)
+        cw2[row] = u128.int_to_limbs((c1 + diff) & MASK128)
+
+    args = dict(n_keys=k, n_codewords=r, n=n)
+    return (SqrtKey(keys=keys1, cw1=cw1, cw2=cw2, **args),
+            SqrtKey(keys=keys2, cw1=cw1, cw2=cw2, **args))
+
+
+def eval_grid(key: SqrtKey, prf_method: int, xp=np):
+    """Full one-hot share, natural order: [N] int32 (low 32 bits).
+
+    One vectorized PRF call over the [R, K] grid — seeds broadcast along
+    rows, positions along columns — then LSB-select of the codeword row.
+    """
+    k, r = key.n_keys, key.n_codewords
+    keys = xp.asarray(key.keys)                       # [K, 4]
+    seeds = xp.broadcast_to(keys[None, :, :], (r, k, 4))
+    rows = xp.arange(r, dtype=xp.uint32)[:, None]     # [R, 1]
+    vals = prf_v(prf_method, seeds, rows)             # [R, K, 4]
+    sel = (keys[None, :, 0] & np.uint32(1))[..., None]
+    cw = xp.where(sel.astype(bool), xp.asarray(key.cw2)[:, None, :],
+                  xp.asarray(key.cw1)[:, None, :])    # [R, K, 4]
+    out = u128.add128(vals, cw)
+    return out[..., 0].astype(xp.int32).reshape(-1)   # x = r*K + j
+
+
+def eval_contract(keys: list, prf_method: int, table: np.ndarray):
+    """Batched fused evaluation on device: [B, E] int32 shares.
+
+    table is the *natural-order* [N, E] int32 table (no bit-reversal —
+    the grid emits natural order).  Exact mod-2^32 contraction.
+    """
+    import jax.numpy as jnp
+
+    shares = jnp.stack([eval_grid(kk, prf_method, jnp) for kk in keys])
+    from ..ops import matmul128
+    return matmul128.dot(shares, jnp.asarray(table))

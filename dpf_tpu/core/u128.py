@@ -150,9 +150,10 @@ def mul128(a, b):
     return _stack_last(r)
 
 
-def mul128_small(a, c: int):
-    """(a * c) mod 2^128 for a compile-time small uint32 constant c."""
-    b_limb = np.uint32(c)
+def mul128_small(a, c):
+    """(a * c) mod 2^128 for a uint32-ranged c: a compile-time int or a
+    broadcastable uint32 array (e.g. per-row positions)."""
+    b_limb = np.uint32(c) if isinstance(c, (int, np.integer)) else c
     zero = a[..., 0] - a[..., 0]
     r = []
     carry = zero

@@ -1,0 +1,99 @@
+"""Sqrt-N DPF construction (core/sqrtn): exhaustive exactness, wire
+round-trip, device/host agreement, fused contraction."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import prf_ref, sqrtn, u128
+
+
+@pytest.mark.parametrize("prf_method", [prf_ref.PRF_DUMMY,
+                                        prf_ref.PRF_SALSA20,
+                                        prf_ref.PRF_CHACHA20,
+                                        prf_ref.PRF_AES128])
+def test_sqrt_exhaustive_small_n(prf_method):
+    """All alphas x all indices: share difference is exactly the point
+    function (host/NumPy grid eval)."""
+    n = 64
+    for alpha in (0, 1, 17, 63):
+        k1, k2 = sqrtn.generate_sqrt_keys(alpha, n, b"sq%d" % alpha,
+                                          prf_method)
+        v1 = sqrtn.eval_grid(k1, prf_method)
+        v2 = sqrtn.eval_grid(k2, prf_method)
+        rec = (v1.astype(np.int64) - v2).astype(np.int32)
+        want = np.zeros(n, dtype=np.int32)
+        want[alpha] = 1
+        assert (rec == want).all(), alpha
+
+
+def test_sqrt_full_128bit_difference():
+    """The difference is beta mod 2^128, not only in the low limb."""
+    n, alpha, beta = 32, 5, (1 << 100) + 12345
+    k1, k2 = sqrtn.generate_sqrt_keys(alpha, n, b"beta", prf_ref.PRF_DUMMY,
+                                      beta=beta)
+    prf = prf_ref.PRF_FUNCS[prf_ref.PRF_DUMMY]
+    for x in range(n):
+        r, j = divmod(x, k1.n_keys)
+        def val(kk):
+            s = u128.limbs_to_int(kk.keys[j])
+            cw = kk.cw2 if s & 1 else kk.cw1
+            return (prf(s, r) + u128.limbs_to_int(cw[r])) & prf_ref.MASK128
+        diff = (val(k1) - val(k2)) % (1 << 128)
+        assert diff == (beta if x == alpha else 0), x
+
+
+def test_sqrt_wire_roundtrip():
+    n = 256
+    k1, _ = sqrtn.generate_sqrt_keys(77, n, b"wire", prf_ref.PRF_CHACHA20)
+    back = sqrtn.deserialize_sqrt_key(k1.serialize())
+    assert back.n == n and back.n_keys == k1.n_keys
+    assert (back.keys == k1.keys).all()
+    assert (back.cw1 == k1.cw1).all() and (back.cw2 == k1.cw2).all()
+    with pytest.raises(ValueError):
+        sqrtn.deserialize_sqrt_key(k1.serialize()[:-4])
+
+
+@pytest.mark.parametrize("prf_method", [prf_ref.PRF_SALSA20,
+                                        prf_ref.PRF_CHACHA20,
+                                        prf_ref.PRF_AES128])
+def test_sqrt_device_matches_host(prf_method):
+    """jnp grid eval (traced position arrays) == NumPy grid eval."""
+    import jax.numpy as jnp
+
+    n = 128
+    k1, k2 = sqrtn.generate_sqrt_keys(100, n, b"dev", prf_method)
+    for kk in (k1, k2):
+        host = sqrtn.eval_grid(kk, prf_method)
+        dev = np.asarray(sqrtn.eval_grid(kk, prf_method, jnp))
+        assert (host == dev).all()
+
+
+def test_sqrt_fused_contraction_recovers_entry():
+    n, e, alpha = 256, 5, 200
+    table = np.random.default_rng(0).integers(
+        0, 2 ** 31, (n, e), dtype=np.int32, endpoint=False)
+    k1, k2 = sqrtn.generate_sqrt_keys(alpha, n, b"tab",
+                                      prf_ref.PRF_CHACHA20)
+    out = np.asarray(sqrtn.eval_contract([k1, k2], prf_ref.PRF_CHACHA20,
+                                         table))
+    rec = (out[0].astype(np.int64) - out[1]).astype(np.int32)
+    assert (rec == table[alpha]).all()
+
+
+def test_sqrt_key_size_scaling():
+    """Key bytes ~ O(sqrt N): the construction's reason to exist."""
+    s1 = sqrtn.generate_sqrt_keys(0, 1 << 10, b"a",
+                                  prf_ref.PRF_DUMMY)[0].serialize().size
+    s2 = sqrtn.generate_sqrt_keys(0, 1 << 14, b"a",
+                                  prf_ref.PRF_DUMMY)[0].serialize().size
+    # N grew 16x; sqrt-N key should grow ~4x, far below linear
+    assert 2 <= s2 / s1 <= 8
+
+
+def test_sqrt_rejects_bad_args():
+    with pytest.raises(ValueError):
+        sqrtn.generate_sqrt_keys(0, 100, b"x", prf_ref.PRF_DUMMY)
+    with pytest.raises(ValueError):
+        sqrtn.generate_sqrt_keys(64, 64, b"x", prf_ref.PRF_DUMMY)
+    with pytest.raises(ValueError):
+        sqrtn.generate_sqrt_keys(0, 64, b"x", prf_ref.PRF_DUMMY, n_keys=3)
