@@ -6,20 +6,23 @@ entries=65536, entry_size=16, PRF=AES-128, batch=512 on one TPU chip —
 the reference's V100 number for this config is 15,392 dpfs/sec
 (README.md:130); vs_baseline = ours / V100.
 
-Relay-safety design (docs/STATUS.md incident): killing a process while it
-is inside a TPU-relay compile wedges the relay for every later process.
-So this bench:
+Relay-safety design (docs/STATUS.md incidents):
 
-* probes the backend with a tiny program first, and evaluates via
-  ``kernel_impl="dispatch"`` — one small XLA program per GGM level,
-  seconds each to compile — never one monolithic program whose compile
-  could outlive any watchdog;
-* runs both the probe and the measurement as **detached subprocesses**
-  (``start_new_session``) and, on timeout, *abandons* them (reports and
-  exits, leaving the child to finish or wait harmlessly) instead of
-  killing them mid-compile;
-* aborts on its soft deadline cooperatively *between* dispatches
-  (``expand.DeadlineExceeded``).
+* The axon relay grants the chip to ONE process at a time and releases
+  a clean exit's grant lazily; a second process claiming during the lag
+  can hang forever ("client lost").  So probe and measurement run in a
+  SINGLE detached worker process (one claim total): the worker prints
+  ``PROBE_OK`` right after its first tiny device op, then measures.
+  The parent watches the worker's log — no PROBE_OK within PROBE_S
+  means the relay is wedged (diagnosed cheaply); a result line means
+  success.
+* Killing a process mid-compile wedges the relay for every later
+  process.  On timeout the parent *abandons* the worker
+  (``start_new_session``; never killed) and the worker itself aborts
+  only cooperatively *between* dispatches (``expand.DeadlineExceeded``).
+* ``kernel_impl="dispatch"`` (one small XLA program per GGM level,
+  seconds each to compile) — never one monolithic program whose
+  compile could outlive any watchdog.
 """
 
 import json
@@ -46,32 +49,24 @@ def _result(value, n, extra=None):
     if extra:
         r.update(extra)
     print(json.dumps(r), flush=True)
+    return r
 
 
-def _wait_abandon(proc, timeout_s):
-    """Wait for a detached child; on timeout leave it running (never kill
-    a process that may hold the TPU grant mid-compile)."""
-    try:
-        return proc.wait(timeout_s)
-    except subprocess.TimeoutExpired:
-        return None  # abandoned, still running
-
-
-def _probe_main():
+def _worker_main(n):
+    """Probe + measurement, one process, one relay claim."""
     import jax
     import jax.numpy as jnp
-    jax.devices()
-    x = jnp.ones((128, 128), jnp.float32)
-    (x @ x).block_until_ready()
-    print("PROBE_OK", flush=True)
-
-
-def _run_main(n):
     import numpy as np
 
     import dpf_tpu
     from dpf_tpu.utils.bench import test_dpf_perf
     from dpf_tpu.utils.config import EvalConfig
+
+    # Probe: first device contact with a tiny program.  PROBE_OK in the
+    # log tells the parent the relay granted us the chip.
+    x = jnp.ones((128, 128), jnp.float32)
+    (x @ x).block_until_ready()
+    print("PROBE_OK", flush=True)
 
     batch = 512
     cfg = EvalConfig(prf_method=dpf_tpu.PRF_AES128, batch_size=batch,
@@ -103,51 +98,56 @@ def main():
     pos = [a for a in sys.argv[1:] if not a.startswith("--")]
     n = int(pos[0]) if pos else 65536
 
-    if "--probe-worker" in sys.argv:
-        _probe_main()
-        return
     if "--run-worker" in sys.argv:
-        _run_main(n)
+        _worker_main(n)
         return
 
-    def spawn(argv):
-        fd, path = tempfile.mkstemp(prefix="dpf_bench_", suffix=".log")
-        child = subprocess.Popen(argv, stdout=fd, stderr=fd,
-                                 start_new_session=True)
-        os.close(fd)
-        return child, path
+    fd, log = tempfile.mkstemp(prefix="dpf_bench_", suffix=".log")
+    worker = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), str(n), "--run-worker"],
+        stdout=fd, stderr=fd, start_new_session=True)
+    os.close(fd)
 
-    # Stage 1: relay probe in a detached child; abandon on timeout.
-    probe, probe_log = spawn(
-        [sys.executable, os.path.abspath(__file__), "--probe-worker"])
-    rc = _wait_abandon(probe, PROBE_S)
-    probe_ok = rc == 0 and "PROBE_OK" in open(probe_log).read()
-    if rc is None:
-        _result(0, n, {"error": "TPU relay unresponsive to a tiny probe "
-                                "program after %ds (wedged); probe child "
-                                "abandoned, not killed" % PROBE_S})
-        sys.exit(2)
-    if not probe_ok:
-        _result(0, n, {"error": "TPU probe exited rc=%s without PROBE_OK"
-                                % rc})
+    def read_log():
+        with open(log) as f:
+            return f.read()
+
+    # Phase 1: wait for first device contact (PROBE_OK in the log).
+    t0 = time.time()
+    probed = False
+    while time.time() - t0 < PROBE_S:
+        if worker.poll() is not None or "PROBE_OK" in read_log():
+            probed = "PROBE_OK" in read_log()
+            break
+        time.sleep(2)
+    if not probed:  # final re-read: PROBE_OK may land during the last sleep
+        probed = "PROBE_OK" in read_log()
+    if not probed and worker.poll() is None:
+        _result(0, n, {"error": "TPU relay unresponsive to the worker's "
+                                "tiny probe program after %ds (wedged); "
+                                "worker abandoned, not killed" % PROBE_S})
         sys.exit(2)
 
-    # Stage 2: the measurement in a detached child; abandon on timeout.
-    worker, run_log = spawn(
-        [sys.executable, os.path.abspath(__file__), str(n), "--run-worker"])
-    rc = _wait_abandon(worker, WATCHDOG_S)
-    out = open(run_log).read().strip()
+    # Phase 2: wait for the result line.
+    rc = None
+    try:
+        rc = worker.wait(WATCHDOG_S)
+    except subprocess.TimeoutExpired:
+        pass  # abandoned, still running
+    out = read_log().strip()
     line = next((ln for ln in reversed(out.splitlines())
                  if ln.startswith("{")), None)
-    if rc == 0 and line:
+    if line and rc in (0, None):
+        # rc None with a result line: the measurement completed and the
+        # worker hung in teardown (grant release) — keep the number
         print(line, flush=True)
         return
     if rc is None:
         _result(0, n, {"error": "TPU backend unresponsive after %ds "
-                                "(relay wedged mid-run?); measurement "
-                                "child abandoned, not killed" % WATCHDOG_S})
+                                "(relay wedged mid-run?); worker "
+                                "abandoned, not killed" % WATCHDOG_S})
         sys.exit(2)
-    _result(0, n, {"error": "measurement worker exited rc=%s; tail: %s"
+    _result(0, n, {"error": "worker exited rc=%s; tail: %s"
                             % (rc, out[-300:])})
     sys.exit(3)
 

@@ -438,6 +438,134 @@ def highway_proxy_core(seeds, pos: int):
     return u128._stack_last([out[0][0], out[0][1], out[1][0], out[1][1]])
 
 
+# ---------------------------------------------------------------------------
+# MD5 (the paper's md5 candidate) — constants derived from sin(), RFC 1321
+# ---------------------------------------------------------------------------
+
+def _md5_k():
+    """K[i] = floor(abs(sin(i+1)) * 2^32) — computed, not transcribed."""
+    import math
+    return [int(math.floor(abs(math.sin(i + 1)) * (1 << 32))) & 0xFFFFFFFF
+            for i in range(64)]
+
+
+_MD5_K = np.array(_md5_k(), dtype=np.uint32)
+_MD5_S = [7, 12, 17, 22] * 4 + [5, 9, 14, 20] * 4 \
+    + [4, 11, 16, 23] * 4 + [6, 10, 15, 21] * 4
+_MD5_IV = (0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476)
+
+
+def md5_core(seeds, pos: int):
+    """MD5(seed LE bytes || pos LE 4 bytes): one padded 64-byte block.
+
+    The 20-byte message occupies m[0..4]; m[5] = 0x80 pad byte; m[14] =
+    160 (bit length).  Output = the 128-bit digest as LE limbs (MD5 state
+    words are little-endian, so A..D map to limbs directly).
+    """
+    zeros = seeds[..., 0] - seeds[..., 0]
+    m = [seeds[..., i] if i < 4 else zeros for i in range(16)]
+    m[4] = zeros + np.uint32(pos & 0xFFFFFFFF)
+    m[5] = zeros + np.uint32(0x80)
+    m[14] = zeros + np.uint32(160)
+    a, b, c, d = (zeros + np.uint32(v) for v in _MD5_IV)
+    for i in range(64):
+        if i < 16:
+            f = (b & c) | (~b & d)
+            g = i
+        elif i < 32:
+            f = (d & b) | (~d & c)
+            g = (5 * i + 1) % 16
+        elif i < 48:
+            f = b ^ c ^ d
+            g = (3 * i + 5) % 16
+        else:
+            f = c ^ (b | ~d)
+            g = (7 * i) % 16
+        f = f + a + np.uint32(_MD5_K[i]) + m[g]
+        a, d, c = d, c, b
+        b = b + _rotl32(f, _MD5_S[i])
+    return u128._stack_last([a + np.uint32(_MD5_IV[0]),
+                             b + np.uint32(_MD5_IV[1]),
+                             c + np.uint32(_MD5_IV[2]),
+                             d + np.uint32(_MD5_IV[3])])
+
+
+# ---------------------------------------------------------------------------
+# SHA-256 (the paper's sha256 candidate) — constants derived exactly from
+# the fractional parts of sqrt/cbrt of the first primes via integer roots
+# ---------------------------------------------------------------------------
+
+def _primes(n):
+    ps, c = [], 2
+    while len(ps) < n:
+        if all(c % p for p in ps):
+            ps.append(c)
+        c += 1
+    return ps
+
+
+def _icbrt(n: int) -> int:
+    x = 1 << ((n.bit_length() + 2) // 3 + 1)
+    while True:
+        y = (2 * x + n // (x * x)) // 3
+        if y >= x:
+            return x
+        x = y
+
+
+def _sha256_consts():
+    import math
+    h0 = [math.isqrt(p << 64) & 0xFFFFFFFF for p in _primes(8)]
+    k = [_icbrt(p << 96) & 0xFFFFFFFF for p in _primes(64)]
+    return h0, k
+
+
+_SHA256_H0, _SHA256_K = _sha256_consts()
+
+
+def _bswap32(x):
+    return ((x >> np.uint32(24)) | (x << np.uint32(24))
+            | ((x >> np.uint32(8)) & np.uint32(0xFF00))
+            | ((x & np.uint32(0xFF00)) << np.uint32(8)))
+
+
+def sha256_core(seeds, pos: int):
+    """SHA-256(seed LE bytes || pos LE 4 bytes) truncated to 128 bits.
+
+    Big-endian message words = byteswapped seed limbs; w[5] = 0x80000000
+    pad; w[15] = 160 (bit length).  Output limbs = byteswapped H[0..3]
+    (so limb bytes equal digest bytes 0..15).
+    """
+    zeros = seeds[..., 0] - seeds[..., 0]
+    w = [None] * 64
+    for i in range(4):
+        w[i] = _bswap32(seeds[..., i])
+    w[4] = _bswap32(zeros + np.uint32(pos & 0xFFFFFFFF))
+    w[5] = zeros + np.uint32(0x80000000)
+    for i in range(6, 15):
+        w[i] = zeros
+    w[15] = zeros + np.uint32(160)
+    for t in range(16, 64):
+        s0 = _rotl32(w[t - 15], 32 - 7) ^ _rotl32(w[t - 15], 32 - 18) \
+            ^ (w[t - 15] >> np.uint32(3))
+        s1 = _rotl32(w[t - 2], 32 - 17) ^ _rotl32(w[t - 2], 32 - 19) \
+            ^ (w[t - 2] >> np.uint32(10))
+        w[t] = w[t - 16] + s0 + w[t - 7] + s1
+    a, b, c, d, e, f, g, h = (zeros + np.uint32(v) for v in _SHA256_H0)
+    for t in range(64):
+        s1 = _rotl32(e, 32 - 6) ^ _rotl32(e, 32 - 11) ^ _rotl32(e, 32 - 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + np.uint32(_SHA256_K[t]) + w[t]
+        s0 = _rotl32(a, 32 - 2) ^ _rotl32(a, 32 - 13) ^ _rotl32(a, 32 - 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e = g, f, e, d + t1
+        d, c, b, a = c, b, a, t1 + t2
+    out = [a + np.uint32(_SHA256_H0[0]), b + np.uint32(_SHA256_H0[1]),
+           c + np.uint32(_SHA256_H0[2]), d + np.uint32(_SHA256_H0[3])]
+    return u128._stack_last([_bswap32(x) for x in out])
+
+
 def _jnp():
     import jax.numpy as jnp
     return jnp
@@ -449,4 +577,6 @@ HASH_ZOO = {
     "blake2s": blake2s_core,
     "keccakf800": keccakf800_core,
     "highway_proxy": highway_proxy_core,
+    "md5": md5_core,
+    "sha256": sha256_core,
 }

@@ -8,11 +8,17 @@ index ``x = r * K + j``):
 
 * Each server holds K 128-bit column seeds, identical across servers
   except at the target column ``j* = alpha % K``, where the two seeds are
-  random with forced-opposite LSBs (server 1 even, server 2 odd).
+  random with *opposite* LSBs.  Which server gets the even seed is itself
+  a coin flip: each server's marginal view is K uniform seeds, so a
+  single server learns nothing about ``j*`` (forcing a fixed parity per
+  server would let it rule out every column whose seed has the other
+  parity — half the columns).
 * Both servers hold the same two codeword arrays ``cw1[R]``, ``cw2[R]``;
   an evaluator adds ``cw1[r]`` or ``cw2[r]`` by the LSB of its column
-  seed.  ``cw2 - cw1 = PRF(s1, r) - PRF(s2, r) - beta*[r == r*]`` makes
-  the shares differ by ``beta`` exactly at ``alpha``.
+  seed.  With ``s_e``/``s_o`` the even/odd target seeds,
+  ``cw2 - cw1 = PRF(s_e, r) - PRF(s_o, r) - (-1)^[server2 is even] *
+  beta * [r == r*]`` makes the shares differ by ``beta`` exactly at
+  ``alpha`` regardless of which server drew the even seed.
 
 Compared with log-N keys (O(log N) size, O(N) PRFs tree-walked), sqrt-N
 keys are O(sqrt N) big but evaluation is a *flat* PRF grid — one
@@ -71,7 +77,10 @@ def deserialize_sqrt_key(arr) -> SqrtKey:
     if slots.shape[0] != 4 + k + 2 * r:
         raise ValueError("malformed sqrt-N key: %d slots for K=%d R=%d"
                          % (slots.shape[0], k, r))
-    return SqrtKey(n_keys=k, n_codewords=r, n=u128.limbs_to_int(slots[2]),
+    n = u128.limbs_to_int(slots[2])
+    if k * r != n:
+        raise ValueError("malformed sqrt-N key: n=%d != K*R=%d" % (n, k * r))
+    return SqrtKey(n_keys=k, n_codewords=r, n=n,
                    keys=slots[4:4 + k].copy(),
                    cw1=slots[4 + k:4 + k + r].copy(),
                    cw2=slots[4 + k + r:].copy())
@@ -103,20 +112,32 @@ def generate_sqrt_keys(alpha: int, n: int, seed: bytes, prf_method: int,
     keys2 = np.zeros((k, 4), dtype=np.uint32)
     for j in range(k):
         if j == j_t:
-            keys1[j] = u128.int_to_limbs(rng.u128() & ~1)
-            keys2[j] = u128.int_to_limbs(rng.u128() | 1)
+            # uniform seed for server 1; server 2 uniform with the
+            # opposite LSB — marginally both are uniform, so neither
+            # server can distinguish the target column from its key
+            s1_val = rng.u128()
+            keys1[j] = u128.int_to_limbs(s1_val)
+            keys2[j] = u128.int_to_limbs(
+                (rng.u128() & ~1) | (1 ^ (s1_val & 1)))
         else:
             keys1[j] = keys2[j] = u128.int_to_limbs(rng.u128())
 
     prf = PRF_FUNCS[prf_method]
     s1 = u128.limbs_to_int(keys1[j_t])
     s2 = u128.limbs_to_int(keys2[j_t])
+    # evaluator picks cw_{lsb(seed)}; with server 1 holding the even seed
+    # the required difference is cw2-cw1 = PRF(s1)-PRF(s2)-beta*[r==r*],
+    # and with roles swapped it is the negation (both servers still index
+    # opposite codeword arrays, so v1-v2 flips sign along with it)
+    s1_even = (s1 & 1) == 0
     cw1 = np.zeros((r, 4), dtype=np.uint32)
     cw2 = np.zeros((r, 4), dtype=np.uint32)
     for row in range(r):
         diff = (prf(s1, row) - prf(s2, row)) & MASK128
         if row == r_t:
             diff = (diff - beta) & MASK128
+        if not s1_even:
+            diff = (-diff) & MASK128
         c1 = rng.u128()
         cw1[row] = u128.int_to_limbs(c1)
         cw2[row] = u128.int_to_limbs((c1 + diff) & MASK128)

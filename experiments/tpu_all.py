@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Consolidated single-process TPU measurement session.
+
+Motivation (2026-07-29 incident, docs/STATUS.md): the axon relay grants
+the chip to ONE process at a time, and the release after a clean process
+exit is laggy (tens of seconds to minutes) — a process that tries to
+claim during the lag can land in the relay's "grant unclaimed — client
+lost" state and hang forever.  Running probe / tuning / sweeps / zoo as
+separate processes therefore multiplies the hang risk by the number of
+process transitions.  This script claims the device ONCE and runs every
+measurement stage in that one process, appending each result line to
+``--out`` (JSONL) the moment it exists, so a mid-session wedge can never
+erase earlier stages.
+
+  python experiments/tpu_all.py [--out tpu_results.jsonl] [--stages a,b,..]
+
+Stages (safest/most-valuable first):
+  probe      tiny matmul; prints PROBE_OK (watch the log for liveness)
+  headline   AES128@65536 batch=512 dispatch — the bench.py metric
+  tuning     knob sweep (aes_impl x unroll x dot x kernel_impl per PRF)
+  table      README-style throughput table: N in {2^14..2^20} x 3 PRFs
+  latency    warm batch=1 latency per PRF x N (coop-kernel role)
+  large      2^22..2^26 single-chip large-table runs
+  zoo        PRF-candidate throughput (paper's PRF-selection experiment)
+  matmul     contraction-impl microbench (matmul_benchmark.cu role)
+"""
+
+import argparse
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ALL_STAGES = ("probe", "headline", "tuning", "table", "latency", "large",
+              "zoo", "matmul")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="tpu_results.jsonl")
+    ap.add_argument("--stages", default=",".join(ALL_STAGES))
+    ap.add_argument("--deadline-s", type=int, default=4 * 3600,
+                    help="soft overall deadline, checked between stages/"
+                         "points (never interrupts a compile)")
+    args = ap.parse_args()
+    stages = [s.strip() for s in args.stages.split(",") if s.strip()]
+    deadline = time.time() + args.deadline_s
+    out = open(args.out, "a", buffering=1)
+
+    def emit(stage, rec):
+        rec = dict(rec)
+        rec["stage"] = stage
+        rec["t"] = round(time.time(), 1)
+        line = json.dumps(rec)
+        out.write(line + "\n")
+        print(line, flush=True)
+
+    def guard(stage, fn, *a, **kw):
+        """Run one measurement point; record errors, keep the session."""
+        if time.time() > deadline:
+            emit(stage, {"skipped": "session deadline"})
+            return None
+        try:
+            return fn(*a, **kw)
+        except Exception as e:  # record + continue: partial data > none
+            emit(stage, {"error": "%s: %s" % (type(e).__name__,
+                                              str(e)[:300])})
+            return None
+
+    import dpf_tpu
+    from dpf_tpu.utils.bench import (test_dpf_latency, test_dpf_perf,
+                                     test_matmul_perf)
+    from dpf_tpu.utils.config import EvalConfig
+
+    PRF_NAMES = {dpf_tpu.PRF_SALSA20: "SALSA20",
+                 dpf_tpu.PRF_CHACHA20: "CHACHA20",
+                 dpf_tpu.PRF_AES128: "AES128"}
+
+    def cfg_for(prf, batch, **kw):
+        # AES always via dispatch mode (monolithic bitsliced compile can
+        # outlive any watchdog through the relay; docs/STATUS.md)
+        if prf == dpf_tpu.PRF_AES128 and "kernel_impl" not in kw:
+            kw["kernel_impl"] = "dispatch"
+            kw.setdefault("round_unroll", False)
+        c = EvalConfig(prf_method=prf, batch_size=batch, **kw)
+        c.apply_globals()
+        return c
+
+    def perf(stage, n, batch, prf, reps=5, check=False, **kw):
+        cfg = cfg_for(prf, batch, **kw)
+        r = test_dpf_perf(N=n, batch=batch, prf=prf, reps=reps,
+                          quiet=True, check=check, config=cfg,
+                          dispatch_deadline=deadline)
+        r["knobs"] = kw
+        emit(stage, r)
+        return r
+
+    # ---- probe ----
+    if "probe" in stages:
+        import jax
+        import jax.numpy as jnp
+        t0 = time.time()
+        devs = jax.devices()
+        x = jnp.ones((128, 128), jnp.int32)
+        (x @ x).block_until_ready()
+        print("PROBE_OK", flush=True)
+        emit("probe", {"devices": [str(d) for d in devs],
+                       "probe_s": round(time.time() - t0, 1)})
+
+    # ---- headline (the bench.py metric, measured with check) ----
+    if "headline" in stages:
+        guard("headline", perf, "headline", 65536, 512,
+              dpf_tpu.PRF_AES128, reps=10, check=True)
+
+    # ---- tuning sweep ----
+    if "tuning" in stages:
+        for aes_impl, unroll in itertools.product(
+                ("bitsliced:bp", "bitsliced:tower", "gather"),
+                (False, True)):
+            guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_AES128,
+                  reps=5, aes_impl=aes_impl, round_unroll=unroll,
+                  kernel_impl="dispatch")
+        for unroll, dot in itertools.product((False, True), ("i32", "mxu")):
+            guard("tuning", perf, "tuning", 65536, 512,
+                  dpf_tpu.PRF_CHACHA20, kernel_impl="xla",
+                  round_unroll=unroll, dot_impl=dot)
+        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_CHACHA20,
+              kernel_impl="dispatch")
+        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_CHACHA20,
+              kernel_impl="pallas")
+        for unroll, dot in itertools.product((False, True), ("i32", "mxu")):
+            guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_SALSA20,
+                  round_unroll=unroll, dot_impl=dot)
+        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_SALSA20,
+              kernel_impl="pallas")
+
+    # ---- README-style throughput table ----
+    if "table" in stages:
+        for n in (1 << 14, 1 << 16, 1 << 18, 1 << 20):
+            for prf in (dpf_tpu.PRF_AES128, dpf_tpu.PRF_SALSA20,
+                        dpf_tpu.PRF_CHACHA20):
+                guard("table", perf, "table", n, 512, prf, reps=5)
+
+    # ---- single-query latency ----
+    if "latency" in stages:
+        for n in (1 << 14, 1 << 16, 1 << 18, 1 << 20):
+            for prf in (dpf_tpu.PRF_AES128, dpf_tpu.PRF_SALSA20,
+                        dpf_tpu.PRF_CHACHA20):
+                def lat(n=n, prf=prf):
+                    cfg = cfg_for(prf, 1)
+                    r = test_dpf_latency(N=n, prf=prf, quiet=True,
+                                         config=cfg)
+                    emit("latency", r)
+                guard("latency", lat)
+
+    # ---- large tables ----
+    if "large" in stages:
+        for n in (1 << 22, 1 << 24, 1 << 26):
+            for prf in (dpf_tpu.PRF_CHACHA20, dpf_tpu.PRF_AES128):
+                guard("large", perf, "large", n, 64, prf, reps=3)
+
+    # ---- PRF zoo ----
+    if "zoo" in stages:
+        def zoo():
+            from dpf_tpu.core.prf_zoo import benchmark_zoo
+            res = benchmark_zoo(n_calls=1 << 20, reps=5)
+            emit("zoo", {"prf_calls_per_sec":
+                         {k: int(v) for k, v in res.items()}})
+        guard("zoo", zoo)
+
+    # ---- contraction microbench ----
+    if "matmul" in stages:
+        def mm():
+            for r in test_matmul_perf(quiet=True).values():
+                emit("matmul", r)
+        guard("matmul", mm)
+
+    emit("session", {"done": True})
+
+
+if __name__ == "__main__":
+    main()

@@ -48,6 +48,28 @@ def test_blake2s_core_vs_hashlib():
             assert int(got[i]) == int.from_bytes(want, "little"), (pos, i)
 
 
+def test_md5_core_vs_hashlib():
+    """MD5(seed || pos) with sin()-derived constants must match hashlib."""
+    seeds = _np_seeds(8)
+    for pos in (0, 1, 42):
+        got = u128.limbs_to_ints(zh.md5_core(seeds, pos))
+        for i, limbs in enumerate(seeds):
+            want = hashlib.md5(_seed_bytes(limbs)
+                               + pos.to_bytes(4, "little")).digest()
+            assert int(got[i]) == int.from_bytes(want, "little"), (pos, i)
+
+
+def test_sha256_core_vs_hashlib():
+    """SHA-256(seed || pos) truncated to 128 bits, integer-root constants."""
+    seeds = _np_seeds(8)
+    for pos in (0, 1, 42):
+        got = u128.limbs_to_ints(zh.sha256_core(seeds, pos))
+        for i, limbs in enumerate(seeds):
+            want = hashlib.sha256(_seed_bytes(limbs)
+                                  + pos.to_bytes(4, "little")).digest()[:16]
+            assert int(got[i]) == int.from_bytes(want, "little"), (pos, i)
+
+
 # ---------------------------------------------------------------------------
 # Vectorized-vs-scalar differentials
 # ---------------------------------------------------------------------------

@@ -26,6 +26,25 @@ def test_sqrt_exhaustive_small_n(prf_method):
         assert (rec == want).all(), alpha
 
 
+def test_sqrt_target_column_parity_is_uniform():
+    """Single-server privacy: the target column's seed LSB must look
+    uniform to each server (a fixed per-server parity would let a lone
+    server rule out half the columns as candidates for alpha % K)."""
+    n, alpha = 256, 77
+    j_t = alpha % sqrtn.default_split(n)[0]
+    lsb1, lsb2 = set(), set()
+    for trial in range(32):
+        k1, k2 = sqrtn.generate_sqrt_keys(alpha, n, b"priv%d" % trial,
+                                          prf_ref.PRF_CHACHA20)
+        b1 = int(k1.keys[j_t, 0] & 1)
+        b2 = int(k2.keys[j_t, 0] & 1)
+        assert b1 ^ b2 == 1  # correctness: opposite parities
+        lsb1.add(b1)
+        lsb2.add(b2)
+    assert lsb1 == {0, 1}, "server 1 target-column parity is constant"
+    assert lsb2 == {0, 1}, "server 2 target-column parity is constant"
+
+
 def test_sqrt_full_128bit_difference():
     """The difference is beta mod 2^128, not only in the low limb."""
     n, alpha, beta = 32, 5, (1 << 100) + 12345
@@ -51,6 +70,10 @@ def test_sqrt_wire_roundtrip():
     assert (back.cw1 == k1.cw1).all() and (back.cw2 == k1.cw2).all()
     with pytest.raises(ValueError):
         sqrtn.deserialize_sqrt_key(k1.serialize()[:-4])
+    bad_n = k1.serialize()
+    bad_n[8] = 2 * n  # n slot inconsistent with K*R
+    with pytest.raises(ValueError):
+        sqrtn.deserialize_sqrt_key(bad_n)
 
 
 @pytest.mark.parametrize("prf_method", [prf_ref.PRF_SALSA20,
