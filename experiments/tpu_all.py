@@ -35,7 +35,7 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ALL_STAGES = ("probe", "headline", "tuning", "table", "latency", "large",
-              "zoo", "matmul")
+              "zoo", "matmul", "profile")
 
 
 def main():
@@ -177,6 +177,25 @@ def main():
             for r in test_matmul_perf(quiet=True).values():
                 emit("matmul", r)
         guard("matmul", mm)
+
+    # ---- op-level traces for roofline verification ----
+    if "profile" in stages:
+        import numpy as np
+
+        from dpf_tpu.utils.profiling import trace
+
+        def prof(prf, name):
+            n, batch = 65536, 512
+            cfg = cfg_for(prf, batch)
+            dpf = dpf_tpu.DPF(prf=prf, config=cfg)
+            k1, _ = dpf.gen(7, n)
+            dpf.eval_init(np.zeros((n, 16), dtype=np.int32))
+            dpf.eval_tpu([k1] * batch)  # compile + warm outside the trace
+            with trace(name, base_dir="tpu_traces") as path:
+                dpf.eval_tpu([k1] * batch)
+            emit("profile", {"config": name, "trace_dir": path})
+        guard("profile", prof, dpf_tpu.PRF_CHACHA20, "chacha_65536_b512")
+        guard("profile", prof, dpf_tpu.PRF_AES128, "aes_dispatch_65536_b512")
 
     emit("session", {"done": True})
 

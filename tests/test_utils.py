@@ -87,6 +87,44 @@ def test_latency_benchmark():
     assert r["mode"] == "latency" and r["latency_ms"] > 0
 
 
+def test_tpu_all_probe_stage_hermetic(tmp_path):
+    """The consolidated measurement session's wiring: probe stage runs on
+    the CPU backend and appends a JSONL record per point."""
+    import subprocess
+    import sys
+    out = str(tmp_path / "res.jsonl")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(repo, "experiments", "tpu_all.py"),
+         "--stages", "probe", "--out", out],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stderr[-500:]
+    assert "PROBE_OK" in r.stdout
+    recs = [json.loads(ln) for ln in open(out)]
+    stages = [rec["stage"] for rec in recs]
+    assert "probe" in stages and "session" in stages
+
+
+def test_scaling_projection_tool(tmp_path):
+    import subprocess
+    import sys
+    res = tmp_path / "r.jsonl"
+    res.write_text(json.dumps({"entries": 1 << 26, "prf": "CHACHA20",
+                               "dpfs_per_sec": 123}) + "\n")
+    out = tmp_path / "SCALING.md"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(repo, "experiments", "scaling_projection.py"),
+         "--results", str(res), "--chips", "64", "--out", str(out)],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr[-500:]
+    text = out.read_text()
+    assert "2^26" in text and "123" in text
+
+
 def test_cpu_baseline_harness():
     from dpf_tpu import native
     if not native.available():
