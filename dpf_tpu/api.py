@@ -90,10 +90,16 @@ class DPF(object):
         aes_impl, round_unroll) — the replacement for the reference's
         compile-time -D flag tiers."""
         self._config = config
+        self.radix = 2
         if config is not None:
             if prf is None:
                 prf = config.prf_method
             self.BATCH_SIZE = config.batch_size
+            self.radix = getattr(config, "radix", 2)
+            if self.radix not in (2, 4):
+                raise ValueError("radix must be 2 or 4")
+            if self.radix == 4 and config.kernel_impl == "pallas":
+                raise ValueError("radix=4 supports kernel_impl xla/dispatch")
         self.prf_method = self.DEFAULT_PRF if prf is None else prf
         self.prf_method_string = PRF_NAMES[self.prf_method]
         self.strict = strict          # enforce reference shape limits
@@ -134,6 +140,11 @@ class DPF(object):
             n = self._pow2_domain(n)
         if seed is None:
             seed = os.urandom(128)
+        if self.radix == 4:
+            from .core import radix4
+            k0, k1 = radix4.generate_keys_r4(k, n, seed, self.prf_method)
+            s0, s1 = k0.serialize(), k1.serialize()
+            return _maybe_torch(s0, True), _maybe_torch(s1, True)
         native_keys = _native_gen(k, n, seed, self.prf_method)
         if native_keys is not None:
             s0, s1 = native_keys
@@ -176,7 +187,12 @@ class DPF(object):
         self.table = tbl
         self.table_num_entries = n
         self.table_effective_entry_size = e
-        self.table_device = jnp.asarray(expand.permute_table(tbl))
+        if self.radix == 4:
+            from .core import radix4
+            perm = radix4.mixed_reverse_indices(radix4.arities(n))
+            self.table_device = jnp.asarray(np.ascontiguousarray(tbl[perm]))
+        else:
+            self.table_device = jnp.asarray(expand.permute_table(tbl))
         self.buffers = (self.table_device,)
         return self.buffers
 
@@ -227,6 +243,17 @@ class DPF(object):
         ``dpf.py:30``): [len(keys), N] int32 shares in natural index order,
         no table involved.  Memory is O(batch x N) — for large N prefer
         eval_tpu (fused) or eval_points (sparse)."""
+        if self.radix == 4:
+            import jax.numpy as jnp
+
+            from .core import radix4
+            torch_io = any(_is_torch(k) for k in keys)
+            mk = self._mixed_batch(keys)
+            cw1, cw2, last = radix4.pack_mixed_keys(mk)
+            out = radix4.expand_leaves_mixed(
+                jnp.asarray(cw1), jnp.asarray(cw2), jnp.asarray(last),
+                n=mk[0].n, prf_method=self.prf_method)
+            return _maybe_torch(np.asarray(out), torch_io)
         (cw1, cw2, last), n, torch_io = self._pack_batch(keys)
         out = expand.expand_leaves(cw1, cw2, last,
                                    depth=n.bit_length() - 1,
@@ -242,6 +269,18 @@ class DPF(object):
         [len(keys), len(indices)] int32 one-hot shares (low 32 bits),
         independent of any table.
         """
+        if self.radix == 4:
+            from .core import radix4
+            torch_io = any(_is_torch(k) for k in keys)
+            mk = self._mixed_batch(keys)
+            idx = np.asarray(indices, dtype=np.uint64)
+            if idx.ndim != 1 or (idx >= mk[0].n).any():
+                raise ValueError("indices must be 1D and < n=%d" % mk[0].n)
+            out = np.array(
+                [[radix4.evaluate_mixed(k, int(i), self.prf_method)
+                  & 0xFFFFFFFF for i in idx] for k in mk],
+                dtype=np.uint32).view(np.int32)
+            return _maybe_torch(out, torch_io)
         (cw1, cw2, last), n, torch_io = self._pack_batch(keys)
         idx = np.asarray(indices, dtype=np.uint64)
         if idx.ndim != 1 or (idx >= n).any():
@@ -252,6 +291,8 @@ class DPF(object):
         return _maybe_torch(np.asarray(out), torch_io)
 
     def _eval_batch(self, keys) -> np.ndarray:
+        if self.radix == 4:
+            return self._eval_batch_r4(keys)
         flat = [keygen.deserialize_key(k) for k in keys]
         n = self.table_num_entries
         for fk in flat:
@@ -298,17 +339,66 @@ class DPF(object):
             round_unroll=round_unroll, kernel_impl=kernel_impl)
         return np.asarray(out)
 
+    def _mixed_batch(self, keys):
+        """Deserialize + validate a radix-4 key batch (uniform n)."""
+        from .core import radix4
+        if not keys:
+            raise ValueError("empty key batch")
+        mk = [radix4.deserialize_mixed_key(k) for k in keys]
+        for k in mk:
+            if k.n != mk[0].n:
+                raise ValueError("keys for mixed table sizes")
+        return mk
+
+    def _eval_batch_r4(self, keys) -> np.ndarray:
+        """Radix-4 device evaluation (core/radix4.py engines)."""
+        from .core import prf as _prf
+        from .core import radix4
+        from .ops import matmul128
+        mk = self._mixed_batch(keys)
+        n = self.table_num_entries
+        for k in mk:
+            if k.n != n:
+                raise ValueError(
+                    "key generated for n=%d but table has n=%d" % (k.n, n))
+        cw1, cw2, last = radix4.pack_mixed_keys(mk)
+        cfg = self._config
+        chunk = (cfg.chunk_leaves if cfg and cfg.chunk_leaves
+                 else expand.choose_chunk(n, len(mk)))
+        dot_impl = cfg.dot_impl if cfg else matmul128.default_impl()
+        aes_impl = (cfg.aes_impl if cfg and cfg.aes_impl != "auto"
+                    else _prf._aes_pair_impl())
+        round_unroll = (cfg.round_unroll if cfg and
+                        cfg.round_unroll is not None else _prf.ROUND_UNROLL)
+        if cfg and cfg.kernel_impl == "dispatch":
+            out = radix4.eval_dispatch_mixed(
+                cw1, cw2, last, self.table_device, n=n,
+                prf_method=self.prf_method, chunk_leaves=chunk,
+                dot_impl=dot_impl, aes_impl=aes_impl,
+                round_unroll=round_unroll,
+                deadline=self.dispatch_deadline)
+        else:
+            out = radix4.expand_and_contract_mixed(
+                cw1, cw2, last, self.table_device, n=n,
+                prf_method=self.prf_method, chunk_leaves=chunk,
+                dot_impl=dot_impl, aes_impl=aes_impl,
+                round_unroll=round_unroll)
+        return np.asarray(out)
+
     # ------------------------------------------------------------ eval_cpu
 
     def eval_cpu(self, keys, one_hot_only=False):
         """Host reference evaluation (native C++ when available, else
         vectorized NumPy breadth-first)."""
         torch_io = any(_is_torch(k) for k in keys)
-        hots = _native_expand_batch(keys, self.prf_method)
-        if hots is None:
-            flat = [keygen.deserialize_key(k) for k in keys]
-            hots = np.stack([evalref.eval_one_hot_i32(fk, self.prf_method)
-                             for fk in flat])  # [B, N] int32
+        if self.radix == 4:
+            from .core import radix4
+            mk = self._mixed_batch(keys)
+            cw1, cw2, last = radix4.pack_mixed_keys(mk)
+            hots = np.asarray(radix4.expand_leaves_mixed(
+                cw1, cw2, last, n=mk[0].n, prf_method=self.prf_method))
+        else:
+            hots = self._binary_one_hots(keys)
         if one_hot_only:
             return _maybe_torch(hots, torch_io)
         if self.table is None:
@@ -318,6 +408,14 @@ class DPF(object):
         # exact wrapping mod-2^32 matmul on host
         prod = hots.astype(np.uint32) @ self.table.view(np.uint32)
         return _maybe_torch(prod.view(np.int32), torch_io or self._torch_io)
+
+    def _binary_one_hots(self, keys):
+        hots = _native_expand_batch(keys, self.prf_method)
+        if hots is None:
+            flat = [keygen.deserialize_key(k) for k in keys]
+            hots = np.stack([evalref.eval_one_hot_i32(fk, self.prf_method)
+                             for fk in flat])  # [B, N] int32
+        return hots
 
     # ------------------------------------------------------------ eval_free
 

@@ -225,16 +225,25 @@ def _concat(parts):
 
 
 def _round(st0, st1, rk, rcon_word, ones, sbox: str | None = None):
-    """One AES round on both states + schedule step.  `mix` outside for the
-    final round.  Fuses all 36 S-box byte positions into one circuit pass.
-    Returns (sub0, sub1, new_rk) with sub* = SubBytes(st*) (pre-ShiftRows).
+    """One AES round on both states + schedule step (see `_round_multi`)."""
+    sub, rk = _round_multi([st0, st1], rk, rcon_word, ones, sbox)
+    return sub[0], sub[1], rk
+
+
+def _round_multi(states, rk, rcon_word, ones, sbox: str | None = None):
+    """One AES round on M states + schedule step.  `mix` outside for the
+    final round.  Fuses all 16*M + 4 S-box byte positions into one circuit
+    pass (the GGM node's children share one key, so their SubBytes and the
+    schedule's RotWord ride a single circuit evaluation).
+    Returns (subs, new_rk) with subs[m] = SubBytes(states[m]) (pre-ShiftRows).
     """
-    fused_in = [_concat([st0[i], st1[i], rk[i][_ROT_WORD]])
+    m_cnt = len(states)
+    fused_in = [_concat([st[i] for st in states] + [rk[i][_ROT_WORD]])
                 for i in range(8)]
     fused_out = _sbox_bits(fused_in, ones, sbox)
-    sub0 = [f[:16] for f in fused_out]
-    sub1 = [f[16:32] for f in fused_out]
-    t = [f[32:36] for f in fused_out]
+    subs = [[f[16 * m:16 * (m + 1)] for f in fused_out]
+            for m in range(m_cnt)]
+    t = [f[16 * m_cnt:16 * m_cnt + 4] for f in fused_out]
     # rcon into byte 0 of the rotated word
     t = [_concat([t[i][0:1] ^ (ones * ((rcon_word >> np.uint32(i))
                                        & np.uint32(1))),
@@ -252,7 +261,7 @@ def _round(st0, st1, rk, rcon_word, ones, sbox: str | None = None):
         else:
             import jax.numpy as jnp
             new_rk.append(jnp.concatenate([w0, w1, w2, w3], axis=0))
-    return sub0, sub1, new_rk
+    return subs, new_rk
 
 
 _RCON_VALS = [None, 1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36]
@@ -260,12 +269,18 @@ _RCON_ARR = np.array(_RCON_VALS[1:], dtype=np.uint32)
 
 
 def _middle_round(st0, st1, rk, rcon_word, ones, sbox: str | None = None):
-    sub0, sub1, rk = _round(st0, st1, rk, rcon_word, ones, sbox)
-    st0 = _mix_columns(_shift_rows(sub0))
-    st1 = _mix_columns(_shift_rows(sub1))
-    st0 = [st0[i] ^ rk[i] for i in range(8)]
-    st1 = [st1[i] ^ rk[i] for i in range(8)]
-    return st0, st1, rk
+    states, rk = _middle_round_multi([st0, st1], rk, rcon_word, ones, sbox)
+    return states[0], states[1], rk
+
+
+def _middle_round_multi(states, rk, rcon_word, ones,
+                        sbox: str | None = None):
+    subs, rk = _round_multi(states, rk, rcon_word, ones, sbox)
+    out = []
+    for sub in subs:
+        st = _mix_columns(_shift_rows(sub))
+        out.append([st[i] ^ rk[i] for i in range(8)])
+    return out, rk
 
 
 def aes128_pair_bitsliced(seeds, unroll: bool | None = None,
@@ -273,11 +288,28 @@ def aes128_pair_bitsliced(seeds, unroll: bool | None = None,
     """Bitsliced AES of positions 0 and 1 under per-element keys.
 
     seeds: [..., 4] uint32 limb array (NumPy or JAX) -> (out0, out1), same
-    shape, matching ``prf_ref.prf_aes128(seed, 0/1)`` bit-exactly.  Under
-    JAX the nine uniform middle rounds run in a ``fori_loop`` (honoring
-    ``unroll``, default = prf.ROUND_UNROLL auto).  ``sbox`` selects the
-    S-box circuit (see ``_sbox_bits``); thread it from a jit-static arg.
+    shape, matching ``prf_ref.prf_aes128(seed, 0/1)`` bit-exactly.  See
+    ``aes128_multi_bitsliced``.
     """
+    return aes128_multi_bitsliced(seeds, 2, unroll, sbox)
+
+
+def aes128_multi_bitsliced(seeds, n_pts: int, unroll: bool | None = None,
+                           sbox: str | None = None):
+    """Bitsliced AES of positions 0..n_pts-1 under per-element keys.
+
+    All plaintexts share one key (the seed), so the key schedule and the
+    S-box circuit passes are fused across them: one round evaluates a
+    single circuit over ``16 * n_pts + 4`` byte positions.  ``n_pts = 2``
+    serves the binary GGM step; ``n_pts = 4`` the radix-4 step, where the
+    schedule's cost amortizes over four children.  Returns a tuple of
+    ``n_pts`` limb arrays shaped like ``seeds``, bit-identical to
+    ``prf_ref.prf_aes128(seed, b)``.  Under JAX the nine uniform middle
+    rounds run in a ``fori_loop`` (honoring ``unroll``, default =
+    prf.ROUND_UNROLL auto); ``sbox`` selects the circuit (``_sbox_bits``),
+    threaded from a jit-static arg.
+    """
+    assert 1 <= n_pts <= 255
     is_np = isinstance(seeds, np.ndarray)
     if is_np:
         xp = np
@@ -305,44 +337,48 @@ def aes128_pair_bitsliced(seeds, unroll: bool | None = None,
     zero = xp.zeros((16, w), dtype=xp.uint32)
     ones = xp.zeros((w,), dtype=xp.uint32) + np.uint32(0xFFFFFFFF)
 
-    # plaintext 0: zero planes; plaintext 1: byte 0 bit 0 set
-    st0 = [zero ^ rk[i] for i in range(8)]
-    one_b0 = _concat([ones[None, :], zero[1:]])
-    st1 = [(one_b0 if i == 0 else zero) ^ rk[i] for i in range(8)]
+    # plaintext b: only byte 0 is nonzero, planes of bit i = [b bit i]
+    states = []
+    for b in range(n_pts):
+        st = []
+        for i in range(8):
+            if (b >> i) & 1:
+                st.append(_concat([ones[None, :], zero[1:]]) ^ rk[i])
+            else:
+                st.append(zero ^ rk[i])
+        states.append(st)
 
     if is_np:
         for rnd in range(1, 10):
-            st0, st1, rk = _middle_round(st0, st1, rk,
-                                         np.uint32(_RCON_VALS[rnd]), ones,
-                                         sbox)
+            states, rk = _middle_round_multi(
+                states, rk, np.uint32(_RCON_VALS[rnd]), ones, sbox)
     else:
         import jax
         from . import prf as _prf
         rcon_arr = xp.asarray(_RCON_ARR)
 
         def body(r, carry):
-            a, b, c = carry
-            st0 = [a[i] for i in range(8)]
-            st1 = [b[i] for i in range(8)]
+            sts, c = carry
+            states = [[sts[j][i] for i in range(8)] for j in range(n_pts)]
             rkl = [c[i] for i in range(8)]
-            st0, st1, rkl = _middle_round(st0, st1, rkl, rcon_arr[r], ones,
-                                          sbox)
-            return (xp.stack(st0), xp.stack(st1), xp.stack(rkl))
+            states, rkl = _middle_round_multi(states, rkl, rcon_arr[r],
+                                              ones, sbox)
+            return (tuple(xp.stack(st) for st in states), xp.stack(rkl))
 
-        carry = (xp.stack(st0), xp.stack(st1), xp.stack(rk))
+        carry = (tuple(xp.stack(st) for st in states), xp.stack(rk))
         carry = jax.lax.fori_loop(0, 9, body, carry,
                                   unroll=_prf._round_unroll()
                                   if unroll is None else unroll)
-        st0 = [carry[0][i] for i in range(8)]
-        st1 = [carry[1][i] for i in range(8)]
-        rk = [carry[2][i] for i in range(8)]
+        states = [[carry[0][j][i] for i in range(8)] for j in range(n_pts)]
+        rk = [carry[1][i] for i in range(8)]
 
     # final round: Sub + Shift + ARK (no MixColumns)
-    sub0, sub1, rk = _round(st0, st1, rk, np.uint32(_RCON_VALS[10]), ones,
+    subs, rk = _round_multi(states, rk, np.uint32(_RCON_VALS[10]), ones,
                             sbox)
-    sh0, sh1 = _shift_rows(sub0), _shift_rows(sub1)
-    st0 = [sh0[i] ^ rk[i] for i in range(8)]
-    st1 = [sh1[i] ^ rk[i] for i in range(8)]
+    outs = []
+    for sub in subs:
+        sh = _shift_rows(sub)
+        outs.append([sh[i] ^ rk[i] for i in range(8)])
 
     def to_limbs(st):
         # st bits[i][byte] -> planes p = 8*byte + i -> limbs
@@ -353,4 +389,4 @@ def aes128_pair_bitsliced(seeds, unroll: bool | None = None,
         out = xp.stack(limbs, axis=-1)[:m]
         return out.reshape(orig_shape)
 
-    return to_limbs(st0), to_limbs(st1)
+    return tuple(to_limbs(st) for st in outs)

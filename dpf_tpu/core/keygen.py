@@ -92,6 +92,9 @@ def deserialize_key(key) -> FlatKey:
         raise ValueError("DPF key must be %d int32 words, got %d"
                          % (KEY_WORDS, arr.shape[0]))
     slots = arr.view(np.uint32).reshape(131, 4)
+    if slots[0, 1] == 4:  # radix marker (binary keys keep this limb zero)
+        raise ValueError("mixed-radix key — use radix4.deserialize_mixed_key"
+                         " (or DPF(config=EvalConfig(radix=4)))")
     return FlatKey(
         depth=int(slots[0, 0]),
         cw1=slots[1:65].copy(),

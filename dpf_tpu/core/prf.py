@@ -462,6 +462,12 @@ def prf_aes128_pair_jax(seeds, unroll: bool | None = None):
     are identical (the seed), so the key schedule — ~1/3 of the per-call
     work — is computed once and shared between the two encryptions.
     """
+    return prf_aes128_multi_jax(seeds, 2, unroll)
+
+
+def prf_aes128_multi_jax(seeds, arity: int, unroll: bool | None = None):
+    """AES of positions 0..arity-1 under the same per-seed key (gather
+    S-box variant); one shared key schedule for all children."""
     import jax
     import jax.numpy as jnp
     sbox = jnp.asarray(_SBOX_NP)
@@ -476,25 +482,24 @@ def prf_aes128_pair_jax(seeds, unroll: bool | None = None):
 
     mix_columns = _aes_mix_columns_jax
 
-    # plaintexts 0 and 1 differ only in byte 0
-    st0 = jnp.stack([zero] * 16) ^ rk
-    st1 = jnp.stack([zero + np.uint32(1)] + [zero] * 15) ^ rk
+    # plaintexts 0..arity-1 differ only in byte 0
+    sts = tuple(jnp.stack([zero + np.uint32(b)] + [zero] * 15) ^ rk
+                for b in range(arity))
 
     def round_body(rnd, carry):
-        st0, st1, rk = carry
-        st0 = mix_columns(sbox[st0][_SHIFT_ROWS])
-        st1 = mix_columns(sbox[st1][_SHIFT_ROWS])
+        sts, rk = carry
+        sts = tuple(mix_columns(sbox[st][_SHIFT_ROWS]) for st in sts)
         rk = next_round_key(rk, rnd)
-        return (st0 ^ rk, st1 ^ rk, rk)
+        return (tuple(st ^ rk for st in sts), rk)
 
-    st0, st1, rk = jax.lax.fori_loop(1, 10, round_body, (st0, st1, rk),
-                                     unroll=_round_unroll() if unroll is None
-                                     else unroll)
+    sts, rk = jax.lax.fori_loop(1, 10, round_body, (sts, rk),
+                                unroll=_round_unroll() if unroll is None
+                                else unroll)
     rk = next_round_key(rk, 10)
-    st0 = sbox[st0][_SHIFT_ROWS] ^ rk
-    st1 = sbox[st1][_SHIFT_ROWS] ^ rk
-    return (_limbs_of_bytes(u128._stack_last([st0[i] for i in range(16)])),
-            _limbs_of_bytes(u128._stack_last([st1[i] for i in range(16)])))
+    sts = tuple(sbox[st][_SHIFT_ROWS] ^ rk for st in sts)
+    return tuple(
+        _limbs_of_bytes(u128._stack_last([st[i] for i in range(16)]))
+        for st in sts)
 
 
 AES_PAIR_IMPL = "auto"  # "auto" | "gather" | "bitsliced"
@@ -518,16 +523,28 @@ def prf_pair(method: int, seeds, aes_impl: str | None = None,
     and ``unroll`` must be threaded from jit *static* arguments by callers
     inside jit (module defaults otherwise) so switching retraces.
     """
+    return prf_multi(method, seeds, 2, aes_impl, unroll)
+
+
+def prf_multi(method: int, seeds, arity: int,
+              aes_impl: str | None = None, unroll: bool | None = None):
+    """All `arity` children PRF(seed, 0..arity-1) — fused where profitable.
+
+    The radix-4 GGM step (``core/radix4.py``) evaluates four children per
+    node; for AES one key schedule and one fused S-box circuit pass per
+    round cover all of them (16*arity + 4 byte positions), amortizing the
+    schedule twice as well as the binary step.
+    """
     if not isinstance(seeds, np.ndarray) and method == PRF_AES128:
         impl = (aes_impl if aes_impl not in (None, "auto")
                 else _aes_pair_impl())
         if impl.startswith("bitsliced"):
             # "bitsliced" or "bitsliced:<sbox>" with sbox in bp/tower/chain
-            from .aes_bitsliced import aes128_pair_bitsliced
+            from .aes_bitsliced import aes128_multi_bitsliced
             sbox = impl.split(":", 1)[1] if ":" in impl else None
-            return aes128_pair_bitsliced(seeds, unroll, sbox)
-        return prf_aes128_pair_jax(seeds, unroll)
-    return prf_v(method, seeds, 0, unroll), prf_v(method, seeds, 1, unroll)
+            return aes128_multi_bitsliced(seeds, arity, unroll, sbox)
+        return prf_aes128_multi_jax(seeds, arity, unroll)
+    return tuple(prf_v(method, seeds, b, unroll) for b in range(arity))
 
 
 def _default_backend_tpu() -> bool:

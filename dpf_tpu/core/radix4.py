@@ -1,0 +1,447 @@
+"""Radix-4 (mixed-radix) GGM DPF — a TPU-native construction.
+
+The wire-compatible binary construction (``core/keygen.py``, matching the
+reference's ``dpf_base/dpf.h:403-464``) expands one bit of the index per
+level: ``2N`` child PRF evaluations and ``log2 N`` level round trips.
+Nothing about the seed-LSB control-bit scheme requires arity 2, and on TPU
+a wider fan-out is strictly better:
+
+* **Total PRF children drop from 2N to 4N/3** (nodes ``(N-1)/3`` instead
+  of ``N-1``; 4 children each).
+* **AES amortizes twice as well**: the bitsliced step fuses all children
+  of a node with the key schedule into ONE S-box circuit pass —
+  ``16*4 + 4 = 68`` byte positions per radix-4 node vs ``36`` per binary
+  node, i.e. ~0.63x the S-box work per leaf
+  (``aes_bitsliced.aes128_multi_bitsliced``).
+* **Half the levels**: half the codeword adds, half the inter-level HBM
+  carries in the scan path, half the per-level programs in dispatch mode.
+
+Construction (generalizing ``keygen.generate_keys`` branch-for-branch):
+each level consumes one radix-``a`` digit of alpha (LSB-first); a level
+owns ``a`` codeword slots per server view; an evaluator picks the cw1 vs
+cw2 array by the LSB of its current seed.  On-path seeds differ by an odd
+beta so LSBs differ; off-path seeds are equal and contributions cancel —
+the same invariant as the binary scheme, with the per-branch codeword
+algebra repeated over 4 branches.  Odd depths take one binary base level
+followed by radix-4 levels (``arities(n)``).
+
+Keys are NOT wire-compatible with the reference (which has no such
+construction); they reuse the same 524-int32 container with a radix
+marker in slot 0 limb 1 (binary keys keep 0 there), and the codeword
+footprint is identical: ``sum(arities) = 2 log2 N <= 64`` slots.
+
+Leaves emerge in digit-reversed BFS order; ``mixed_reverse_indices``
+gives the table permutation (the binary case reduces to bit reversal,
+``dpf_wrapper.cu:104-109``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import u128
+from .keygen import KEY_WORDS, Shake256Drbg
+from .prf_ref import MASK128, PRF_FUNCS
+
+MAX_CW = 64
+
+
+def arities(n: int) -> tuple[int, ...]:
+    """Eval-order level arities for table size n: a binary base level iff
+    depth is odd, then radix-4 all the way up."""
+    depth = n.bit_length() - 1
+    out = (2,) if depth % 2 else ()
+    return out + (4,) * (depth // 2)
+
+
+def cw_offsets(ars) -> list:
+    """Slot offset of each level's codeword block (eval order)."""
+    offs, o = [], 0
+    for a in ars:
+        offs.append(o)
+        o += a
+    return offs
+
+
+def mixed_reverse_indices(ars) -> np.ndarray:
+    """perm[bfs_pos] = alpha landing there under breadth-first expansion.
+
+    Eval consumes digits LSB-first, so BFS position has alpha's digits
+    most-significant-first: reversing mixed-radix digits.  All-2 arities
+    reduce to classic bit reversal.
+    """
+    n = int(np.prod(ars))
+    rem = np.arange(n, dtype=np.int64)
+    alpha = np.zeros(n, dtype=np.int64)
+    block = n
+    mult = 1
+    for a in ars:
+        block //= a
+        d, rem = np.divmod(rem, block)
+        alpha += d * mult
+        mult *= a
+    return alpha
+
+
+@dataclass
+class MixedKey:
+    """One server's mixed-radix DPF key (host representation)."""
+    arities: tuple       # eval order; level j consumes digit j (LSB-first)
+    cw1: np.ndarray      # [64, 4] uint32 (slots beyond sum(arities) zero)
+    cw2: np.ndarray      # [64, 4] uint32
+    last_key: int        # 128-bit start seed
+    n: int
+
+    def serialize(self) -> np.ndarray:
+        """-> [524] int32: binary-key container + radix marker.
+
+        Slot 0 = (depth, radix marker 4, n_binary_levels, 0); the rest of
+        the layout mirrors ``keygen.FlatKey.serialize`` with codeword
+        blocks at ``cw_offsets`` (eval order) instead of the binary
+        ``2i + b`` scheme.
+        """
+        depth = self.n.bit_length() - 1
+        slots = np.zeros((131, 4), dtype=np.uint32)
+        slots[0, 0] = depth
+        slots[0, 1] = 4
+        slots[0, 2] = sum(1 for a in self.arities if a == 2)
+        slots[1:65] = self.cw1
+        slots[65:129] = self.cw2
+        slots[129] = u128.int_to_limbs(self.last_key)
+        slots[130] = u128.int_to_limbs(self.n)
+        return slots.reshape(-1).view(np.int32).copy()
+
+
+def is_mixed_key(arr) -> bool:
+    """True if a 524-word key carries the radix marker."""
+    a = np.asarray(arr, dtype=np.int32).reshape(-1)
+    return a.shape[0] == KEY_WORDS and a.view(np.uint32)[1] == 4
+
+
+def deserialize_mixed_key(arr) -> MixedKey:
+    a = np.asarray(arr, dtype=np.int32).reshape(-1)
+    if a.shape[0] != KEY_WORDS:
+        raise ValueError("mixed-radix key must be %d int32 words, got %d"
+                         % (KEY_WORDS, a.shape[0]))
+    slots = a.view(np.uint32).reshape(131, 4)
+    if slots[0, 1] != 4:
+        raise ValueError("not a mixed-radix key (marker %d)"
+                         % int(slots[0, 1]))
+    n = u128.limbs_to_int(slots[130])
+    ars = arities(n)
+    if (int(slots[0, 0]) != n.bit_length() - 1
+            or int(slots[0, 2]) != sum(1 for x in ars if x == 2)):
+        raise ValueError("mixed-radix key header inconsistent with n=%d" % n)
+    return MixedKey(arities=ars, cw1=slots[1:65].copy(),
+                    cw2=slots[65:129].copy(),
+                    last_key=u128.limbs_to_int(slots[129]), n=n)
+
+
+def generate_keys_r4(alpha: int, n: int, seed: bytes, prf_method: int,
+                     beta: int = 1):
+    """Two servers' mixed-radix keys for f(alpha) = beta (mod 2^128).
+
+    Same bottom-up derivation as ``keygen.generate_keys`` with the branch
+    loop widened per level arity.  O(log N) PRF calls, host side.
+    """
+    if n & (n - 1) != 0 or n < 2:
+        raise ValueError("table size (%d) must be a power of two >= 2" % n)
+    if not 0 <= alpha < n:
+        raise ValueError("alpha (%d) must be in [0, %d)" % (alpha, n))
+    ars = arities(n)
+    offs = cw_offsets(ars)
+    levels = len(ars)
+    prf = PRF_FUNCS[prf_method]
+    rng = Shake256Drbg(seed)
+
+    cw1 = np.zeros((MAX_CW, 4), dtype=np.uint32)
+    cw2 = np.zeros((MAX_CW, 4), dtype=np.uint32)
+
+    digits = []
+    rem = alpha
+    for a in ars:
+        digits.append(rem % a)
+        rem //= a
+
+    # --- base level (eval step 0) ---------------------------------------
+    a0 = ars[0]
+    k1 = rng.u128() & ~1          # server 0 start seed: LSB 0
+    k2 = rng.u128() | 1           # server 1 start seed: LSB 1
+    beta_l = beta if levels == 1 else rng.u128_odd()
+    tb = digits[0]
+    c1 = [rng.u128() for _ in range(a0)]
+    for b in range(a0):
+        d = (prf(k1, b) - prf(k2, b)) & MASK128
+        if b == tb:
+            d = (d - beta_l) & MASK128
+        cw1[offs[0] + b] = u128.int_to_limbs(c1[b])
+        cw2[offs[0] + b] = u128.int_to_limbs((c1[b] + d) & MASK128)
+    s1 = (prf(k1, tb) + c1[tb]) & MASK128
+    s2 = (prf(k2, tb)
+          + u128.limbs_to_int(cw2[offs[0] + tb])) & MASK128
+
+    # --- upper levels, bottom to top -------------------------------------
+    for j in range(1, levels):
+        assert (s1 - s2) & MASK128 == beta_l and (s1 ^ s2) & 1
+        a = ars[j]
+        beta_l = beta if j == levels - 1 else rng.u128_odd()
+        tb = digits[j]
+        s1_even = (s1 & 1) == 0
+        c1 = [rng.u128() for _ in range(a)]
+        for b in range(a):
+            d = (prf(s2, b) - prf(s1, b)) & MASK128
+            if s1_even:
+                d = (-d) & MASK128
+            cw2[offs[j] + b] = u128.int_to_limbs((c1[b] + d) & MASK128)
+        c1[tb] = (c1[tb] + (beta_l if s1_even else -beta_l)) & MASK128
+        for b in range(a):
+            cw1[offs[j] + b] = u128.int_to_limbs(c1[b])
+        n1 = (prf(s1, tb) + (c1[tb] if s1_even else
+                             u128.limbs_to_int(cw2[offs[j] + tb]))) & MASK128
+        n2 = (prf(s2, tb) + (u128.limbs_to_int(cw2[offs[j] + tb])
+                             if s1_even else c1[tb])) & MASK128
+        s1, s2 = n1, n2
+
+    ka = MixedKey(arities=ars, cw1=cw1, cw2=cw2, last_key=k1, n=n)
+    kb = MixedKey(arities=ars, cw1=cw1.copy(), cw2=cw2.copy(),
+                  last_key=k2, n=n)
+    return ka, kb
+
+
+def evaluate_mixed(key: MixedKey, indx: int, prf_method: int) -> int:
+    """Scalar reference evaluation at one index (O(log N) PRF calls)."""
+    prf = PRF_FUNCS[prf_method]
+    offs = cw_offsets(key.arities)
+    cur = key.last_key
+    rem = indx
+    for j, a in enumerate(key.arities):
+        b = rem % a
+        val = prf(cur, b)
+        cw = key.cw1 if (cur & 1) == 0 else key.cw2
+        cur = (val + u128.limbs_to_int(cw[offs[j] + b])) & MASK128
+        rem //= a
+    return cur
+
+
+# ---------------------------------------------------------------------------
+# Batched evaluation (host NumPy and device JAX share the level step)
+# ---------------------------------------------------------------------------
+
+def pack_mixed_keys(keys) -> tuple:
+    """List of MixedKey -> (cw1 [B,64,4], cw2, last [B,4]) uint32."""
+    bsz = len(keys)
+    cw1 = np.zeros((bsz, MAX_CW, 4), dtype=np.uint32)
+    cw2 = np.zeros((bsz, MAX_CW, 4), dtype=np.uint32)
+    last = np.zeros((bsz, 4), dtype=np.uint32)
+    for i, k in enumerate(keys):
+        cw1[i] = k.cw1
+        cw2[i] = k.cw2
+        last[i] = u128.int_to_limbs(k.last_key)
+    return cw1, cw2, last
+
+
+def _level_step_mixed(seeds, cw1_lvl, cw2_lvl, prf_method: int, arity: int,
+                      aes_impl=None, round_unroll=None):
+    """One mixed-radix level: seeds [B, w, 4], cw*_lvl [B, a, 4]
+    -> [B, a*w, 4] children (node-major: child b of node j at a*j + b)."""
+    from .prf import prf_multi
+    xp = np if isinstance(seeds, np.ndarray) else _jnp()
+    sel = (seeds[..., 0] & np.uint32(1)).astype(bool)[..., None]
+    outs = prf_multi(prf_method, seeds, arity, aes_impl, round_unroll)
+    children = []
+    for b in range(arity):
+        cw = xp.where(sel, cw2_lvl[:, None, b, :], cw1_lvl[:, None, b, :])
+        children.append(u128.add128(outs[b], cw))
+    stacked = xp.stack(children, axis=2)              # [B, w, a, 4]
+    bsz, w = seeds.shape[0], seeds.shape[1]
+    return stacked.reshape(bsz, arity * w, 4)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def expand_leaves_mixed(cw1, cw2, last, *, n: int, prf_method: int,
+                        natural_order: bool = True):
+    """Full expansion to [B, N] low-32 leaf shares (NumPy or JAX arrays in
+    -> same kind out).  Debug / one-hot path."""
+    ars = arities(n)
+    offs = cw_offsets(ars)
+    xp = np if isinstance(last, np.ndarray) else _jnp()
+    seeds = last[:, None, :]
+    for j, a in enumerate(ars):
+        c1 = cw1[:, offs[j]:offs[j] + a, :]
+        c2 = cw2[:, offs[j]:offs[j] + a, :]
+        seeds = _level_step_mixed(seeds, c1, c2, prf_method, a)
+    lo = seeds[..., 0].astype(xp.int32)               # [B, N] BFS order
+    if not natural_order:
+        return lo
+    # natural[perm[p]] = bfs[p]
+    perm = mixed_reverse_indices(ars)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size)
+    return lo[:, inv]
+
+
+def _suffix_chunk(ars, target: int) -> tuple:
+    """Split levels so phase 2 covers a trailing suffix with product <=
+    target (at least the last level): returns (f_levels, chunk)."""
+    prod = 1
+    j = len(ars)
+    while j > 0 and prod * ars[j - 1] <= max(target, ars[-1]):
+        j -= 1
+        prod *= ars[j]
+    return j, prod
+
+
+def _expand_contract_mixed_core(cw1, cw2, last, per_chunk_tables, dot_fn, *,
+                                ars, offs, f_lv, prf_method, aes_impl,
+                                round_unroll, out_width):
+    import jax.numpy as jnp
+    from jax import lax
+
+    bsz = last.shape[0]
+
+    def level(seeds, j):
+        a = ars[j]
+        return _level_step_mixed(
+            seeds, cw1[:, offs[j]:offs[j] + a, :],
+            cw2[:, offs[j]:offs[j] + a, :], prf_method, a,
+            aes_impl, round_unroll)
+
+    seeds = last[:, None, :]
+    for j in range(f_lv):
+        seeds = level(seeds, j)                       # [B, F, 4]
+
+    def expand_subtree(node_seeds):
+        s = node_seeds[:, None, :]
+        for j in range(f_lv, len(ars)):
+            s = level(s, j)
+        return s[..., 0].astype(jnp.int32)            # [B, C]
+
+    if f_lv == 0:
+        return dot_fn(expand_subtree(seeds[:, 0, :]), per_chunk_tables[0])
+
+    frontier = jnp.moveaxis(seeds, 1, 0)              # [F, B, 4]
+
+    def body(acc, xs):
+        node_seeds, chunk = xs
+        return acc + dot_fn(expand_subtree(node_seeds), chunk), None
+
+    acc0 = jnp.zeros((bsz, out_width), dtype=jnp.int32)
+    acc, _ = lax.scan(body, acc0, (frontier, per_chunk_tables))
+    return acc
+
+
+def _expand_and_contract_mixed_jit(cw1, cw2, last, table_perm, *, n,
+                                   prf_method, chunk_leaves, dot_impl,
+                                   aes_impl, round_unroll):
+    from .expand import _dot_i32
+    ars = arities(n)
+    offs = cw_offsets(ars)
+    e = table_perm.shape[1]
+    f_lv, c = _suffix_chunk(ars, chunk_leaves or n)
+    f = n // c
+    return _expand_contract_mixed_core(
+        cw1, cw2, last, table_perm.reshape(f, c, e),
+        lambda leaves, chunk: _dot_i32(leaves, chunk, dot_impl),
+        ars=ars, offs=offs, f_lv=f_lv, prf_method=prf_method,
+        aes_impl=aes_impl, round_unroll=round_unroll, out_width=e)
+
+
+_RUN_JIT = None  # module-level jit wrapper: one trace cache per process
+
+
+def expand_and_contract_mixed(cw1, cw2, last, table_perm, *, n: int,
+                              prf_method: int, chunk_leaves: int | None,
+                              dot_impl: str = "i32", aes_impl=None,
+                              round_unroll=None):
+    """Batched fused mixed-radix evaluation against one shared table.
+
+    table_perm: [N, E] int32, pre-permuted with ``mixed_reverse_indices``.
+    Returns [B, E] int32 shares.  The fused/monolithic counterpart of
+    ``expand.expand_and_contract`` for radix-4 keys.
+    """
+    import functools
+    global _RUN_JIT
+    if _RUN_JIT is None:
+        import jax
+        _RUN_JIT = functools.partial(
+            jax.jit, static_argnames=("n", "prf_method", "chunk_leaves",
+                                      "dot_impl", "aes_impl",
+                                      "round_unroll")
+        )(_expand_and_contract_mixed_jit)
+
+    import jax.numpy as jnp
+    return _RUN_JIT(jnp.asarray(cw1), jnp.asarray(cw2), jnp.asarray(last),
+                    table_perm, n=n, prf_method=prf_method,
+                    chunk_leaves=chunk_leaves, dot_impl=dot_impl,
+                    aes_impl=aes_impl, round_unroll=round_unroll)
+
+
+_STEP_JIT = None  # module-level per-level jit (cached across batches)
+
+
+def eval_dispatch_mixed(cw1, cw2, last, table_perm, *, n: int,
+                        prf_method: int, chunk_leaves: int | None,
+                        dot_impl: str = "i32", aes_impl=None,
+                        round_unroll=None, deadline=None):
+    """Per-level-program mixed-radix evaluation (the relay-safe mode for
+    bitsliced AES — compile time linear in level count, which radix-4
+    halves).  Same math as ``expand_and_contract_mixed``."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from .expand import DeadlineExceeded, _group_contract
+
+    def check_deadline():
+        if deadline is not None and _time.time() > deadline:
+            raise DeadlineExceeded(
+                "eval_dispatch soft deadline passed between dispatches")
+
+    global _STEP_JIT
+    if _STEP_JIT is None:
+        _STEP_JIT = jax.jit(_level_step_mixed,
+                            static_argnames=("prf_method", "arity",
+                                             "aes_impl", "round_unroll"))
+    step = _STEP_JIT
+
+    ars = arities(n)
+    offs = cw_offsets(ars)
+    e = table_perm.shape[1]
+    f_lv, c = _suffix_chunk(ars, chunk_leaves or n)
+    f = n // c
+    bsz = last.shape[0]
+    g = max(1, min(f, (1 << 18) // c))
+    while f % g:
+        g -= 1
+
+    cw1 = jnp.asarray(cw1)
+    cw2 = jnp.asarray(cw2)
+
+    def level(seeds, j):
+        check_deadline()
+        a = ars[j]
+        return step(seeds, cw1[:, offs[j]:offs[j] + a, :],
+                    cw2[:, offs[j]:offs[j] + a, :], prf_method, a,
+                    aes_impl, round_unroll)
+
+    seeds = jnp.asarray(last)[:, None, :]
+    for j in range(f_lv):
+        seeds = level(seeds, j)                       # [B, f, 4]
+
+    tables = jnp.asarray(table_perm).reshape(f, c, e)
+    acc = jnp.zeros((bsz, e), dtype=jnp.int32)
+    for start in range(0, f, g):
+        s = seeds[:, start:start + g, :]
+        for j in range(f_lv, len(ars)):
+            s = level(s, j)
+        leaves = s[..., 0].astype(jnp.int32).reshape(bsz, g, c)
+        acc = _group_contract(acc, leaves, tables[start:start + g],
+                              dot_impl)
+    return acc

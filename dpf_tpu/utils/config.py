@@ -19,6 +19,9 @@ class EvalConfig:
     aes_impl: str = "auto"  # "auto"|"gather"|"bitsliced"[":bp"|":tower"]
     kernel_impl: str = "xla"  # "xla" | "pallas" (ChaCha/Salsa subtree
     #                  kernel) | "dispatch" (per-level programs; fast compile)
+    radix: int = 2  # 2 = reference-wire-compatible binary GGM;
+    #                 4 = TPU-native radix-4 (core/radix4.py): 2/3 the PRF
+    #                 children, half the levels, 2x AES schedule amortization
 
     def with_(self, **kw) -> "EvalConfig":
         return replace(self, **kw)

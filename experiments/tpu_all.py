@@ -136,6 +136,18 @@ def main():
                   round_unroll=unroll, dot_impl=dot)
         guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_SALSA20,
               kernel_impl="pallas")
+        # radix-4 construction (core/radix4.py): 2/3 the PRF children,
+        # half the levels, 2x AES schedule amortization — vs binary above
+        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_AES128,
+              radix=4, aes_impl="bitsliced:bp", round_unroll=False,
+              kernel_impl="dispatch")
+        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_AES128,
+              radix=4, aes_impl="bitsliced:bp", round_unroll=True,
+              kernel_impl="dispatch")
+        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_CHACHA20,
+              radix=4)
+        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_SALSA20,
+              radix=4)
 
     # ---- README-style throughput table ----
     if "table" in stages:
