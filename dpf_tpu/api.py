@@ -276,11 +276,11 @@ class DPF(object):
             idx = np.asarray(indices, dtype=np.uint64)
             if idx.ndim != 1 or (idx >= mk[0].n).any():
                 raise ValueError("indices must be 1D and < n=%d" % mk[0].n)
-            out = np.array(
-                [[radix4.evaluate_mixed(k, int(i), self.prf_method)
-                  & 0xFFFFFFFF for i in idx] for k in mk],
-                dtype=np.uint32).view(np.int32)
-            return _maybe_torch(out, torch_io)
+            cw1, cw2, last = radix4.pack_mixed_keys(mk)
+            out = radix4.eval_points_mixed(
+                cw1, cw2, last, idx.astype(np.uint32), n=mk[0].n,
+                prf_method=self.prf_method)
+            return _maybe_torch(np.asarray(out), torch_io)
         (cw1, cw2, last), n, torch_io = self._pack_batch(keys)
         idx = np.asarray(indices, dtype=np.uint64)
         if idx.ndim != 1 or (idx >= n).any():
@@ -410,6 +410,12 @@ class DPF(object):
         return _maybe_torch(prod.view(np.int32), torch_io or self._torch_io)
 
     def _binary_one_hots(self, keys):
+        from .core import radix4
+        for k in keys:  # marker check BEFORE the native fast path, which
+            #             would otherwise misparse mixed-radix layouts
+            if radix4.is_mixed_key(_to_numpy(k, np.int32)):
+                raise ValueError(
+                    "mixed-radix key — use DPF(config=EvalConfig(radix=4))")
         hots = _native_expand_batch(keys, self.prf_method)
         if hots is None:
             flat = [keygen.deserialize_key(k) for k in keys]

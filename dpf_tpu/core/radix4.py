@@ -150,6 +150,9 @@ def generate_keys_r4(alpha: int, n: int, seed: bytes, prf_method: int,
         raise ValueError("table size (%d) must be a power of two >= 2" % n)
     if not 0 <= alpha < n:
         raise ValueError("alpha (%d) must be in [0, %d)" % (alpha, n))
+    if n.bit_length() - 1 > 32:  # sum(arities) = 2*depth must fit MAX_CW
+        raise ValueError("table size 2^%d exceeds max 2^32"
+                         % (n.bit_length() - 1))
     ars = arities(n)
     offs = cw_offsets(ars)
     levels = len(ars)
@@ -284,6 +287,44 @@ def expand_leaves_mixed(cw1, cw2, last, *, n: int, prf_method: int,
     inv = np.empty_like(perm)
     inv[perm] = np.arange(perm.size)
     return lo[:, inv]
+
+
+def eval_points_mixed(cw1, cw2, last, indices, *, n: int, prf_method: int,
+                      aes_impl: str = "gather"):
+    """Per-index root-to-leaf walks on device: [B,...] keys x [Q] indices.
+
+    Mixed-radix counterpart of ``expand.eval_points`` (the naive-strategy
+    surface): O(Q log4 N) PRF calls per key, natural-order output,
+    [B, Q] int32.  Levels are a static Python loop (arities vary per
+    level); gather S-box for AES (single-seed walks — bitslicing would
+    pad each call to 32 lanes).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .prf import prf_multi
+
+    ars = arities(n)
+    offs = cw_offsets(ars)
+    indices = jnp.asarray(indices, dtype=jnp.uint32)
+
+    def walk(cw1_k, cw2_k, last_k, idx):
+        seed, rem = last_k, idx
+        for j, a in enumerate(ars):
+            b = (rem % np.uint32(a)).astype(jnp.int32)
+            outs = prf_multi(prf_method, seed[None, :], a, aes_impl)
+            val = jnp.stack([o[0] for o in outs])[b]      # [4]
+            sel = (seed[0] & np.uint32(1)).astype(bool)
+            cw_pair = jnp.where(sel, cw2_k[offs[j] + b],
+                                cw1_k[offs[j] + b])
+            seed = u128.add128(val, cw_pair)
+            rem = rem // np.uint32(a)
+        return seed[0].astype(jnp.int32)
+
+    per_key = jax.vmap(jax.vmap(walk, in_axes=(None, None, None, 0)),
+                       in_axes=(0, 0, 0, None))
+    return per_key(jnp.asarray(cw1), jnp.asarray(cw2), jnp.asarray(last),
+                   indices)
 
 
 def _suffix_chunk(ars, target: int) -> tuple:

@@ -140,6 +140,82 @@ def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
     return acc
 
 
+def shard_table_mixed(table_i32: np.ndarray, mesh: Mesh):
+    """Digit-reverse-permute (radix-4 BFS order) and row-shard a table."""
+    from ..core import radix4
+    tbl = np.asarray(table_i32, dtype=np.int32)
+    perm = radix4.mixed_reverse_indices(radix4.arities(tbl.shape[0]))
+    sharding = NamedSharding(mesh, P("table", None))
+    return jax.device_put(jnp.asarray(np.ascontiguousarray(tbl[perm])),
+                          sharding)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("n", "prf_method", "chunk_leaves",
+                                    "mesh", "aes_impl"))
+def eval_sharded_mixed(cw1, cw2, last, table_perm, *, n: int,
+                       prf_method: int, chunk_leaves: int, mesh: Mesh,
+                       aes_impl: str | None = None):
+    """Mesh-parallel radix-4 evaluation (the mixed-radix counterpart of
+    ``eval_sharded``): each chip owns whole trailing radix-4 subtrees of
+    the digit-reversed table, expands only those, psums partials."""
+    from ..core import radix4
+    ars = radix4.arities(n)
+    offs = radix4.cw_offsets(ars)
+    n_shards = mesh.shape["table"]
+    shard_rows = n // n_shards
+    assert shard_rows * n_shards == n and shard_rows >= ars[-1]
+    f_lv, c = radix4._suffix_chunk(ars, min(chunk_leaves, shard_rows))
+
+    def _mixed_level(seeds, cw1_l, cw2_l, j):
+        a = ars[j]
+        return radix4._level_step_mixed(
+            seeds, cw1_l[:, offs[j]:offs[j] + a, :],
+            cw2_l[:, offs[j]:offs[j] + a, :], prf_method, a, aes_impl)
+
+    def per_shard(cw1_l, cw2_l, last_l, tbl_shard):
+        shard_ix = jax.lax.axis_index("table")
+        rows = tbl_shard.shape[0]
+        e = tbl_shard.shape[1]
+        bsz = last_l.shape[0]
+        f_local = rows // c
+
+        seeds = last_l[:, None, :]
+        for j in range(f_lv):
+            seeds = _mixed_level(seeds, cw1_l, cw2_l, j)
+        node0 = (shard_ix * rows) // c
+        seeds = jax.lax.dynamic_slice_in_dim(seeds, node0, f_local, axis=1)
+
+        def expand_subtree(node_seeds):
+            s = node_seeds[:, None, :]
+            for j in range(f_lv, len(ars)):
+                s = _mixed_level(s, cw1_l, cw2_l, j)
+            return s[..., 0].astype(jnp.int32)
+
+        tbl_chunks = tbl_shard.reshape(f_local, c, e)
+        if f_local == 1:
+            out = expand._dot_i32(expand_subtree(seeds[:, 0, :]),
+                                  tbl_chunks[0])
+        else:
+            frontier = jnp.moveaxis(seeds, 1, 0)
+
+            def body(acc, xs):
+                node_seeds, chunk = xs
+                return acc + expand._dot_i32(expand_subtree(node_seeds),
+                                             chunk), None
+
+            acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
+            acc0 = jax.lax.pvary(acc0, ("batch", "table"))
+            out, _ = jax.lax.scan(body, acc0, (frontier, tbl_chunks))
+        return jax.lax.psum(out, "table")
+
+    fn = jax.shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P("batch"), P("batch"), P("batch"), P("table", None)),
+        out_specs=P("batch", None))
+    return fn(cw1, cw2, last, table_perm)
+
+
 class ShardedDPFServer:
     """Convenience server wrapper: one table, mesh-parallel evaluation.
 
@@ -147,23 +223,46 @@ class ShardedDPFServer:
     """
 
     def __init__(self, table, mesh: Mesh | None = None, prf_method: int = 3,
-                 batch_size: int = 512):
+                 batch_size: int = 512, radix: int = 2):
         from ..core import keygen  # local import to avoid cycles
         self._keygen = keygen
         self.mesh = mesh if mesh is not None else make_mesh()
         tbl = np.asarray(table, dtype=np.int32)
         self.n, self.entry_size = tbl.shape
         assert self.n & (self.n - 1) == 0
+        assert radix in (2, 4)
+        self.radix = radix
         self.depth = self.n.bit_length() - 1
         self.prf_method = prf_method
         self.batch_size = batch_size
-        self.table_sharded = shard_table(tbl, self.mesh)
+        if radix == 4:
+            self.table_sharded = shard_table_mixed(tbl, self.mesh)
+        else:
+            self.table_sharded = shard_table(tbl, self.mesh)
         shard_rows = self.n // self.mesh.shape["table"]
         self.chunk = min(expand.choose_chunk(self.n, batch_size), shard_rows)
 
     def eval(self, keys) -> np.ndarray:
         if not keys:
             raise ValueError("empty key batch")
+        from ..core import prf as _prf
+        if self.radix == 4:
+            from ..core import radix4
+            mk = [radix4.deserialize_mixed_key(k) for k in keys]
+            for k in mk:
+                if k.n != self.n:
+                    raise ValueError(
+                        "key generated for n=%d but table has n=%d"
+                        % (k.n, self.n))
+            eff = len(mk)
+            pad = (-eff) % max(self.mesh.shape["batch"], 1)
+            mk = mk + [mk[-1]] * pad
+            cw1, cw2, last = radix4.pack_mixed_keys(mk)
+            out = eval_sharded_mixed(
+                cw1, cw2, last, self.table_sharded, n=self.n,
+                prf_method=self.prf_method, chunk_leaves=self.chunk,
+                mesh=self.mesh, aes_impl=_prf._aes_pair_impl())
+            return np.asarray(out)[:eff]
         flat = [self._keygen.deserialize_key(k) for k in keys]
         for fk in flat:
             if fk.n != self.n:
@@ -174,7 +273,6 @@ class ShardedDPFServer:
         pad = (-eff) % max(nb, 1)
         flat = flat + [flat[-1]] * pad
         cw1, cw2, last = expand.pack_keys(flat)
-        from ..core import prf as _prf
         out = eval_sharded(cw1, cw2, last, self.table_sharded,
                            depth=self.depth, prf_method=self.prf_method,
                            chunk_leaves=self.chunk, mesh=self.mesh,

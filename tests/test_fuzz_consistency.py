@@ -65,3 +65,38 @@ def test_all_paths_agree():
         b = np.asarray(dpf.eval_tpu([k1.serialize()]))
         assert ((a - b).astype(np.int32) == table[alpha]).all(), \
             (n, alpha, prf)
+
+
+def test_radix4_paths_agree():
+    """Same differential net over the radix-4 construction: scalar eval,
+    NumPy BFS, device BFS, fused contraction through the public API."""
+    from dpf_tpu.core import radix4
+    from dpf_tpu.utils.config import EvalConfig
+
+    for n, alpha, prf in _random_configs(4):
+        seed = b"r4fuzz-%d-%d-%d" % (n, alpha, prf)
+        k0, k1 = radix4.generate_keys_r4(alpha, n, seed, prf)
+
+        for mk in (k0, k1):
+            cw1, cw2, last = radix4.pack_mixed_keys([mk])
+            # 1. NumPy BFS vs scalar eval at sampled indices
+            hot = radix4.expand_leaves_mixed(cw1, cw2, last, n=n,
+                                             prf_method=prf)[0]
+            for i in {0, alpha, n - 1, int(RNG.integers(0, n))}:
+                want = radix4.evaluate_mixed(mk, i, prf) & 0xFFFFFFFF
+                assert int(hot.view(np.uint32)[i]) == want, (n, alpha, i)
+            # 2. device BFS
+            dev = np.asarray(radix4.expand_leaves_mixed(
+                jnp.asarray(cw1), jnp.asarray(cw2), jnp.asarray(last),
+                n=n, prf_method=prf))
+            assert (dev[0] == hot).all(), (n, alpha, prf)
+
+        # 3. fused contraction through the public API
+        table = RNG.integers(-2 ** 31, 2 ** 31, (n, 3),
+                             dtype=np.int64).astype(np.int32)
+        dpf = DPF(config=EvalConfig(prf_method=prf, radix=4))
+        dpf.eval_init(table)
+        a = np.asarray(dpf.eval_tpu([k0.serialize()]))
+        b = np.asarray(dpf.eval_tpu([k1.serialize()]))
+        assert ((a - b).astype(np.int32) == table[alpha]).all(), \
+            (n, alpha, prf)

@@ -138,6 +138,21 @@ def test_r4_odd_depth_api_round_trip():
     assert (rec[0] == table[77]).all()
 
 
+def test_r4_key_rejected_by_binary_eval_cpu_native_path():
+    """The native fast path must not misparse mixed-radix keys either."""
+    cfg = EvalConfig(prf_method=prf_ref.PRF_CHACHA20, radix=4)
+    d4 = dpf_tpu.DPF(config=cfg)
+    k1, _ = d4.gen(3, 256)
+    db = dpf_tpu.DPF(prf=prf_ref.PRF_CHACHA20)
+    with pytest.raises(ValueError):
+        db.eval_cpu([k1], one_hot_only=True)
+
+
+def test_r4_depth_bound():
+    with pytest.raises(ValueError):
+        radix4.generate_keys_r4(1, 1 << 33, b"big", prf_ref.PRF_DUMMY)
+
+
 def test_r4_mixed_n_batch_rejected():
     cfg = EvalConfig(prf_method=prf_ref.PRF_CHACHA20, radix=4)
     d = dpf_tpu.DPF(config=cfg)
