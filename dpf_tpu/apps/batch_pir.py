@@ -262,10 +262,12 @@ class PrivateLookupServer:
     loops bins on the host instead.
     """
 
-    def __init__(self, table: np.ndarray, bins, prf=None):
+    def __init__(self, table: np.ndarray, bins, prf=None, radix: int = 2):
         from ..api import DPF
-        from ..core import expand
+        from ..core import expand, radix4
         self.prf_method = DPF.DEFAULT_PRF if prf is None else prf
+        assert radix in (2, 4)
+        self.radix = radix
         self.entry_size = table.shape[1]
         self.bins = [sorted(b) for b in bins]
         self.bin_sizes = []
@@ -277,23 +279,49 @@ class PrivateLookupServer:
             padded[:len(sub)] = sub
             padded_tables.append(padded)
             self.bin_sizes.append(n)
+
+        def permute(padded):
+            if radix == 4:
+                perm = radix4.mixed_reverse_indices(
+                    radix4.arities(padded.shape[0]))
+                return np.ascontiguousarray(padded[perm])
+            return expand.permute_table(padded)
+
         # group bins by padded size -> one stacked [G, n, E] device array each
         import jax.numpy as jnp
         self._groups = {}  # n -> (bin indices, stacked permuted tables)
         for bi, (n, padded) in enumerate(zip(self.bin_sizes, padded_tables)):
             self._groups.setdefault(n, [[], []])
             self._groups[n][0].append(bi)
-            self._groups[n][1].append(expand.permute_table(padded))
+            self._groups[n][1].append(permute(padded))
         self._groups = {
             n: (idxs, jnp.asarray(np.stack(tbls)))
             for n, (idxs, tbls) in self._groups.items()}
 
     def answer(self, keys_per_bin):
         """keys_per_bin: one serialized key per bin -> [n_bins, E] shares."""
-        from ..core import expand, keygen
+        from ..core import expand, keygen, radix4
         from ..core import prf as _prf
+        from ..ops import matmul128
         out = np.zeros((len(self.bins), self.entry_size), np.int32)
         for n, (idxs, tables) in self._groups.items():
+            if self.radix == 4:
+                mk = [radix4.deserialize_mixed_key(keys_per_bin[bi])
+                      for bi in idxs]
+                for k in mk:
+                    if k.n != n:
+                        raise ValueError(
+                            "key for bin of size %d got n=%d" % (n, k.n))
+                cw1, cw2, last = radix4.pack_mixed_keys(mk)
+                shares = radix4.expand_and_contract_per_key_tables_mixed(
+                    cw1, cw2, last, tables, n=n,
+                    prf_method=self.prf_method,
+                    chunk_leaves=expand.choose_chunk(n, len(mk)),
+                    dot_impl=matmul128.default_impl(),
+                    aes_impl=_prf._aes_pair_impl(),
+                    round_unroll=_prf.ROUND_UNROLL)
+                out[idxs] = np.asarray(shares)
+                continue
             flat = [keygen.deserialize_key(keys_per_bin[bi]) for bi in idxs]
             for fk in flat:
                 if fk.n != n:
@@ -301,7 +329,6 @@ class PrivateLookupServer:
                         "key for bin of size %d got n=%d" % (n, fk.n))
             cw1, cw2, last = expand.pack_keys(flat)
             depth = n.bit_length() - 1
-            from ..ops import matmul128
             shares = expand.expand_and_contract_per_key_tables(
                 cw1, cw2, last, tables, depth=depth,
                 prf_method=self.prf_method,
@@ -316,9 +343,15 @@ class PrivateLookupServer:
 class PrivateLookupClient:
     """Generates per-bin keys for a planned fetch and recovers entries."""
 
-    def __init__(self, bins, bin_sizes, prf=None):
+    def __init__(self, bins, bin_sizes, prf=None, radix: int = 2):
         from ..api import DPF
-        self.dpf = DPF(prf=prf)
+        if radix == 4:
+            from ..utils.config import EvalConfig
+            self.dpf = DPF(config=EvalConfig(
+                prf_method=DPF.DEFAULT_PRF if prf is None else prf,
+                radix=4))
+        else:
+            self.dpf = DPF(prf=prf)
         self.bins = [sorted(b) for b in bins]
         self.bin_sizes = bin_sizes
         self.index_to_bin = {}

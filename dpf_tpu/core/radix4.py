@@ -423,6 +423,67 @@ def expand_and_contract_mixed(cw1, cw2, last, table_perm, *, n: int,
                     aes_impl=aes_impl, round_unroll=round_unroll)
 
 
+def _per_key_tables_mixed_jit(cw1, cw2, last, tables_perm, *, n,
+                              prf_method, chunk_leaves, dot_impl,
+                              aes_impl, round_unroll):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    ars = arities(n)
+    offs = cw_offsets(ars)
+    bsz, _, e = tables_perm.shape
+    f_lv, c = _suffix_chunk(ars, chunk_leaves or n)
+    f = n // c
+
+    def bdot(leaves, chunk):
+        # [B, C] x [B, C, E] -> [B, E], batched over keys, mod 2^32
+        from ..ops import matmul128
+        if (dot_impl or "i32") == "i32":
+            return lax.dot_general(
+                leaves, chunk, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.int32)
+        return jax.vmap(lambda a, t: matmul128.dot(a[None, :], t,
+                                                   dot_impl)[0])(leaves,
+                                                                 chunk)
+
+    chunks = jnp.moveaxis(tables_perm.reshape(bsz, f, c, e), 1, 0)
+    return _expand_contract_mixed_core(
+        cw1, cw2, last, chunks, bdot, ars=ars, offs=offs, f_lv=f_lv,
+        prf_method=prf_method, aes_impl=aes_impl,
+        round_unroll=round_unroll, out_width=e)
+
+
+_PKT_JIT = None
+
+
+def expand_and_contract_per_key_tables_mixed(
+        cw1, cw2, last, tables_perm, *, n: int, prf_method: int,
+        chunk_leaves: int | None, dot_impl: str = "i32", aes_impl=None,
+        round_unroll=None):
+    """Radix-4 fused evaluation where every key has its OWN table.
+
+    tables_perm: [B, N, E] int32, each digit-reverse-permuted.  The
+    mixed-radix counterpart of
+    ``expand.expand_and_contract_per_key_tables`` (the batch-PIR bin
+    protocol's one-dispatch-per-round path).
+    """
+    import functools
+    global _PKT_JIT
+    if _PKT_JIT is None:
+        import jax
+        _PKT_JIT = functools.partial(
+            jax.jit, static_argnames=("n", "prf_method", "chunk_leaves",
+                                      "dot_impl", "aes_impl",
+                                      "round_unroll")
+        )(_per_key_tables_mixed_jit)
+    import jax.numpy as jnp
+    return _PKT_JIT(jnp.asarray(cw1), jnp.asarray(cw2), jnp.asarray(last),
+                    tables_perm, n=n, prf_method=prf_method,
+                    chunk_leaves=chunk_leaves, dot_impl=dot_impl,
+                    aes_impl=aes_impl, round_unroll=round_unroll)
+
+
 _STEP_JIT = None  # module-level per-level jit (cached across batches)
 
 

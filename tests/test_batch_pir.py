@@ -119,6 +119,30 @@ def test_private_lookup_end_to_end():
         assert (got[w] == table[w]).all()
 
 
+def test_private_lookup_end_to_end_radix4():
+    """The same bin protocol served by the radix-4 construction."""
+    n, e = 300, 4
+    table = np.random.randint(0, 2 ** 31, (n, e), dtype=np.int64).astype(
+        np.int32)
+    train = _access_patterns(n_entries=n, seed=3)
+    opt = BatchPIROptimize(
+        train, train, HotColdConfig(1.0), CollocateConfig(0),
+        PIRConfig(bin_fraction=0.34, queries_to_hot=1))
+
+    server_a = PrivateLookupServer(table, opt.hot_table_bins,
+                                   prf=DPF.PRF_CHACHA20, radix=4)
+    server_b = PrivateLookupServer(table, opt.hot_table_bins,
+                                   prf=DPF.PRF_CHACHA20, radix=4)
+    client = PrivateLookupClient(opt.hot_table_bins, server_a.bin_sizes,
+                                 prf=DPF.PRF_CHACHA20, radix=4)
+
+    wanted = [sorted(b)[0] for b in opt.hot_table_bins[:3]]
+    ka, kb, plan = client.make_queries(wanted)
+    got = client.recover(server_a.answer(ka), server_b.answer(kb), plan)
+    for w in wanted:
+        assert w in got and (got[w] == table[w]).all()
+
+
 def test_fetch_prefers_unrecovered_most_needed():
     """Pin one_query's selection: with a tight budget, each per-bin query
     must go to the most-needed *unrecovered* candidate — an
