@@ -91,6 +91,7 @@ class DPF(object):
         compile-time -D flag tiers."""
         self._config = config
         self.radix = 2
+        self.scheme = "logn"
         if config is not None:
             if prf is None:
                 prf = config.prf_method
@@ -98,8 +99,11 @@ class DPF(object):
             self.radix = getattr(config, "radix", 2)
             if self.radix not in (2, 4):
                 raise ValueError("radix must be 2 or 4")
-            if self.radix == 4 and config.kernel_impl == "pallas":
-                raise ValueError("radix=4 supports kernel_impl xla/dispatch")
+            self.scheme = getattr(config, "scheme", "logn")
+            if self.scheme not in ("logn", "sqrtn"):
+                raise ValueError("scheme must be 'logn' or 'sqrtn'")
+            if self.scheme == "sqrtn" and self.radix == 4:
+                raise ValueError("scheme='sqrtn' has no radix; use radix=2")
         self.prf_method = self.DEFAULT_PRF if prf is None else prf
         self.prf_method_string = PRF_NAMES[self.prf_method]
         self.strict = strict          # enforce reference shape limits
@@ -140,6 +144,11 @@ class DPF(object):
             n = self._pow2_domain(n)
         if seed is None:
             seed = os.urandom(128)
+        if self.scheme == "sqrtn":
+            from .core import sqrtn
+            k0, k1 = sqrtn.generate_sqrt_keys(k, n, seed, self.prf_method)
+            s0, s1 = k0.serialize(), k1.serialize()
+            return _maybe_torch(s0, True), _maybe_torch(s1, True)
         if self.radix == 4:
             from .core import radix4
             k0, k1 = radix4.generate_keys_r4(k, n, seed, self.prf_method)
@@ -187,7 +196,10 @@ class DPF(object):
         self.table = tbl
         self.table_num_entries = n
         self.table_effective_entry_size = e
-        if self.radix == 4:
+        if self.scheme == "sqrtn":
+            # the sqrt-N grid emits natural order — no permutation
+            self.table_device = jnp.asarray(tbl)
+        elif self.radix == 4:
             from .core import radix4
             perm = radix4.mixed_reverse_indices(radix4.arities(n))
             self.table_device = jnp.asarray(np.ascontiguousarray(tbl[perm]))
@@ -243,6 +255,15 @@ class DPF(object):
         ``dpf.py:30``): [len(keys), N] int32 shares in natural index order,
         no table involved.  Memory is O(batch x N) — for large N prefer
         eval_tpu (fused) or eval_points (sparse)."""
+        if self.scheme == "sqrtn":
+            import jax.numpy as jnp
+
+            from .core import sqrtn
+            torch_io = any(_is_torch(k) for k in keys)
+            sk = self._sqrt_batch(keys)
+            out = np.stack([np.asarray(sqrtn.eval_grid(
+                k, self.prf_method, jnp)) for k in sk])
+            return _maybe_torch(out, torch_io)
         if self.radix == 4:
             import jax.numpy as jnp
 
@@ -269,6 +290,15 @@ class DPF(object):
         [len(keys), len(indices)] int32 one-hot shares (low 32 bits),
         independent of any table.
         """
+        if self.scheme == "sqrtn":
+            from .core import sqrtn
+            torch_io = any(_is_torch(k) for k in keys)
+            sk = self._sqrt_batch(keys)
+            idx = np.asarray(indices, dtype=np.int64)
+            if idx.ndim != 1 or (idx >= sk[0].n).any() or (idx < 0).any():
+                raise ValueError("indices must be 1D and < n=%d" % sk[0].n)
+            out = sqrtn.eval_points_sqrt(sk, idx, self.prf_method)
+            return _maybe_torch(out, torch_io)
         if self.radix == 4:
             from .core import radix4
             torch_io = any(_is_torch(k) for k in keys)
@@ -290,7 +320,40 @@ class DPF(object):
                                  prf_method=self.prf_method)
         return _maybe_torch(np.asarray(out), torch_io)
 
+    def _sqrt_batch(self, keys):
+        """Deserialize + validate a sqrt-N key batch (uniform split)."""
+        from .core import sqrtn
+        if not keys:
+            raise ValueError("empty key batch")
+        sk = [sqrtn.deserialize_sqrt_key(_to_numpy(k, np.int32))
+              for k in keys]
+        for k in sk:
+            if (k.n, k.n_keys) != (sk[0].n, sk[0].n_keys):
+                raise ValueError("keys for mixed sqrt-N splits")
+        return sk
+
+    def _eval_batch_sqrt(self, keys) -> np.ndarray:
+        """Sqrt-N device evaluation: flat PRF grid + fused contraction
+        (core/sqrtn.py), natural-order table."""
+        from .core import sqrtn
+        from .ops import matmul128
+        sk = self._sqrt_batch(keys)
+        n = self.table_num_entries
+        for k in sk:
+            if k.n != n:
+                raise ValueError(
+                    "key generated for n=%d but table has n=%d" % (k.n, n))
+        seeds, cw1, cw2 = sqrtn.pack_sqrt_keys(sk)
+        dot_impl = (self._config.dot_impl if self._config
+                    else matmul128.default_impl())
+        out = sqrtn.eval_contract_batched(
+            seeds, cw1, cw2, self.table_device,
+            prf_method=self.prf_method, dot_impl=dot_impl)
+        return np.asarray(out)
+
     def _eval_batch(self, keys) -> np.ndarray:
+        if self.scheme == "sqrtn":
+            return self._eval_batch_sqrt(keys)
         if self.radix == 4:
             return self._eval_batch_r4(keys)
         flat = [keygen.deserialize_key(k) for k in keys]
@@ -304,7 +367,9 @@ class DPF(object):
         kernel_impl = self._config.kernel_impl if self._config else "xla"
         if self._config and self._config.chunk_leaves:
             chunk = self._config.chunk_leaves
-        elif kernel_impl == "pallas":
+        elif kernel_impl == "pallas" and self.prf_method != PRF_AES128:
+            # subtree-kernel chunk is bounded by per-tile VMEM state;
+            # the AES plane-level kernel uses the standard memory bound
             from .ops.pallas_level import pallas_chunk_leaves
             chunk = pallas_chunk_leaves(n)
         else:
@@ -370,7 +435,12 @@ class DPF(object):
                     else _prf._aes_pair_impl())
         round_unroll = (cfg.round_unroll if cfg and
                         cfg.round_unroll is not None else _prf.ROUND_UNROLL)
-        if cfg and cfg.kernel_impl == "dispatch":
+        if cfg and cfg.kernel_impl == "pallas":
+            out = radix4.expand_and_contract_mixed_pallas(
+                cw1, cw2, last, self.table_device, n=n,
+                prf_method=self.prf_method, aes_impl=aes_impl,
+                dot_impl=dot_impl)
+        elif cfg and cfg.kernel_impl == "dispatch":
             out = radix4.eval_dispatch_mixed(
                 cw1, cw2, last, self.table_device, n=n,
                 prf_method=self.prf_method, chunk_leaves=chunk,
@@ -391,7 +461,12 @@ class DPF(object):
         """Host reference evaluation (native C++ when available, else
         vectorized NumPy breadth-first)."""
         torch_io = any(_is_torch(k) for k in keys)
-        if self.radix == 4:
+        if self.scheme == "sqrtn":
+            from .core import sqrtn
+            sk = self._sqrt_batch(keys)
+            hots = np.stack([sqrtn.eval_grid(k, self.prf_method)
+                             for k in sk])
+        elif self.radix == 4:
             from .core import radix4
             mk = self._mixed_batch(keys)
             cw1, cw2, last = radix4.pack_mixed_keys(mk)

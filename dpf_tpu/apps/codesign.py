@@ -28,12 +28,15 @@ def join_sweep_with_perf(sweep_results, perf_results, entry_size_bytes=64):
         raise ValueError("no perf results to join against")
 
     def dpfs_per_sec_for(table_len):
+        """(rate, extrapolated?) — the smallest measured size covering
+        ``table_len``, or a 1/N extrapolation past the largest measured
+        point (flagged so frontier consumers can see which points rest
+        on real measurements)."""
         for entries, rate in perf_by_entries:
             if entries >= max(table_len, 1):
-                return rate
-        # extrapolate past the largest benchmark ~ 1/N scaling
+                return rate, False
         entries, rate = perf_by_entries[-1]
-        return rate * entries / max(table_len, 1)
+        return rate * entries / max(table_len, 1), True
 
     points = []
     for s in sweep_results:
@@ -47,9 +50,11 @@ def join_sweep_with_perf(sweep_results, perf_results, entry_size_bytes=64):
         cold_bins = (extra["cold_table_size"]
                      // max(extra["cold_table_entries_per_bin"], 1)
                      if extra["cold_table_size"] else 0)
-        hot_rate = dpfs_per_sec_for(extra["hot_table_entries_per_bin"])
-        cold_rate = (dpfs_per_sec_for(extra["cold_table_entries_per_bin"])
-                     if cold_bins else float("inf"))
+        hot_rate, hot_ex = dpfs_per_sec_for(
+            extra["hot_table_entries_per_bin"])
+        cold_rate, cold_ex = (
+            dpfs_per_sec_for(extra["cold_table_entries_per_bin"])
+            if cold_bins else (float("inf"), False))
         # hot and cold tables served by two devices in parallel (ref :49-133)
         hot_time = qh * hot_bins / hot_rate
         cold_time = (qc * cold_bins / cold_rate) if cold_bins else 0.0
@@ -63,6 +68,7 @@ def join_sweep_with_perf(sweep_results, perf_results, entry_size_bytes=64):
                                 else float("inf")),
             "upload_bytes": s["cost"]["upload_communication"],
             "download_bytes": s["cost"]["download_communication"],
+            "perf_extrapolated": bool(hot_ex or cold_ex),
         })
     points.sort(key=lambda p: p["mean_recovered"], reverse=True)
     return points

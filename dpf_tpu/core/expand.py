@@ -151,9 +151,15 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
     f = n // c  # frontier width
     assert c * f == n and depth == int(np.log2(n))
     if kernel_impl == "pallas":
-        from ..core.prf import PRF_CHACHA20, PRF_SALSA20
+        from ..core.prf import PRF_AES128, PRF_CHACHA20, PRF_SALSA20
+        if prf_method == PRF_AES128:
+            sbox = (aes_impl.split(":", 1)[1]
+                    if aes_impl and ":" in aes_impl else None)
+            return _expand_contract_pallas_aes(
+                cw1, cw2, last, table_perm, depth=depth,
+                chunk_leaves=c, dot_impl=dot_impl, sbox=sbox)
         assert prf_method in (PRF_CHACHA20, PRF_SALSA20), (
-            "kernel_impl='pallas' supports ChaCha20/Salsa20 only")
+            "kernel_impl='pallas' supports ChaCha20/Salsa20/AES128")
         return _expand_contract_pallas(cw1, cw2, last, table_perm,
                                        depth=depth, f=f,
                                        prf_method=prf_method)
@@ -213,8 +219,8 @@ def eval_dispatch(cw1, cw2, last, table_perm, *, depth: int,
     f = n // c
     assert c * f == n and depth == int(np.log2(n))
     bsz = last.shape[0]
-    g = group or max(1, min(f, (1 << 18) // c))
-    while f % g:
+    g = group or choose_group(f, c)
+    while f % g:  # explicit `group` may not divide f
         g -= 1
     f_levels = int(np.log2(f))
 
@@ -259,6 +265,79 @@ def _expand_contract_pallas(cw1, cw2, last, table_perm, *, depth: int,
     return subtree_contract_pallas(
         seeds, cw1, cw2, table_perm, depth=depth, f_levels=f_levels,
         interpret=interpret, prf_method=prf_method)
+
+
+def choose_group(f: int, c: int) -> int:
+    """Frontier nodes expanded together: the largest divisor of ``f``
+    keeping the live leaf tensor under ~2^18 x batch x 16 B (shared by
+    the dispatch and Pallas-AES drivers)."""
+    g = max(1, min(f, (1 << 18) // c))
+    while f % g:
+        g -= 1
+    return g
+
+
+def grouped_scan_contract(seeds, table_perm, expand_fn, *, f: int, c: int,
+                          dot_impl: str = "i32"):
+    """Phase-2 grouping under ``lax.scan``: split the ``f`` frontier
+    nodes ([B, F, 4] ``seeds``) into equal groups of g, expand each group
+    with ``expand_fn([B, g, 4]) -> [B, g*c]`` leaves, contract against
+    the matching table rows, accumulate [B, E].  Equal shapes per group
+    make the whole loop one scanned program; live memory is bounded at
+    ``B x g x c x 16 B``."""
+    e = table_perm.shape[1]
+    bsz = seeds.shape[0]
+    g = choose_group(f, c)
+
+    def body(acc, xs):
+        node_seeds, chunk = xs                        # [B, g, 4], [g*c, E]
+        leaves = expand_fn(node_seeds)                # [B, g*c]
+        return acc + _dot_i32(leaves, chunk, dot_impl), None
+
+    acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
+    tables = table_perm.reshape(f // g, g * c, e)
+    grouped = jnp.moveaxis(seeds.reshape(bsz, f // g, g, 4), 1, 0)
+    if f // g == 1:
+        acc, _ = body(acc0, (grouped[0], tables[0]))
+        return acc
+    acc, _ = lax.scan(body, acc0, (grouped, tables))
+    return acc
+
+
+def _expand_contract_pallas_aes(cw1, cw2, last, table_perm, *, depth: int,
+                                chunk_leaves: int, dot_impl: str = "i32",
+                                sbox: str | None = None,
+                                interpret: bool = False):
+    """AES via the plane-domain Pallas level kernel (ops/aes_planes.py).
+
+    AES is compute-bound, so unlike the ChaCha subtree kernel there is no
+    inter-level VMEM-residency win; each level is one fast-compiling
+    Pallas program, and frontier groups ride ``grouped_scan_contract``.
+    """
+    from ..ops.aes_planes import aes_level_step_pallas
+    n, e = table_perm.shape
+    c = chunk_leaves
+    f = n // c
+    f_levels = int(np.log2(f))
+
+    def level(s, l):
+        i = depth - 1 - l
+        return aes_level_step_pallas(
+            s, cw1[:, 2 * i:2 * i + 2, :], cw2[:, 2 * i:2 * i + 2, :],
+            arity=2, sbox=sbox, interpret=interpret)
+
+    seeds = last[:, None, :]
+    for l in range(f_levels):
+        seeds = level(seeds, l)                       # [B, F, 4]
+
+    def expand_fn(node_seeds):
+        s = node_seeds
+        for l in range(f_levels, depth):
+            s = level(s, l)
+        return s[..., 0].astype(jnp.int32)            # [B, g*c]
+
+    return grouped_scan_contract(seeds, table_perm, expand_fn, f=f, c=c,
+                                 dot_impl=dot_impl)
 
 
 def _dot_i32(a, b, impl: str | None = None):

@@ -8,7 +8,10 @@ round-count variants (Salsa20/8, Salsa20/20, ChaCha8, ChaCha20, ...) and a
 throughput benchmark to compare candidates on real hardware.
 
 NOTE: zoo variants are NOT wire-compatible with the reference keys — they
-exist for PRF-selection studies, like the paper's.
+exist for PRF-selection studies, like the paper's.  Of the 13 candidates,
+``highway_proxy`` is an op-mix *proxy* for the HighwayHash family (same
+instruction mix and widths, NOT the published constants/algorithm — see
+``prf_zoo_hash.py``); every summary of the zoo should carry that asterisk.
 """
 
 from __future__ import annotations

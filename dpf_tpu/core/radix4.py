@@ -187,7 +187,10 @@ def generate_keys_r4(alpha: int, n: int, seed: bytes, prf_method: int,
 
     # --- upper levels, bottom to top -------------------------------------
     for j in range(1, levels):
-        assert (s1 - s2) & MASK128 == beta_l and (s1 ^ s2) & 1
+        if not ((s1 - s2) & MASK128 == beta_l and (s1 ^ s2) & 1):
+            raise AssertionError(
+                "radix keygen invariant broken at level %d: seed shares "
+                "must differ by the odd beta' (and so in LSB)" % j)
         a = ars[j]
         beta_l = beta if j == levels - 1 else rng.u128_odd()
         tb = digits[j]
@@ -482,6 +485,94 @@ def expand_and_contract_per_key_tables_mixed(
                     tables_perm, n=n, prf_method=prf_method,
                     chunk_leaves=chunk_leaves, dot_impl=dot_impl,
                     aes_impl=aes_impl, round_unroll=round_unroll)
+
+
+def _mixed_pallas_aes(cw1, cw2, last, table_perm, *, n, sbox, interpret,
+                      dot_impl="i32"):
+    """Radix-4 AES via the plane-domain Pallas level kernel: grouped
+    breadth-first expansion under ``lax.scan`` (the mixed counterpart of
+    ``expand._expand_contract_pallas_aes``)."""
+    import jax.numpy as jnp
+
+    from ..ops.aes_planes import aes_level_step_pallas
+    from .expand import choose_chunk, grouped_scan_contract
+
+    ars = arities(n)
+    offs = cw_offsets(ars)
+    bsz = last.shape[0]
+    f_lv, c = _suffix_chunk(ars, choose_chunk(n, bsz))
+    f = n // c
+
+    def level(s, j):
+        a = ars[j]
+        return aes_level_step_pallas(
+            s, cw1[:, offs[j]:offs[j] + a, :],
+            cw2[:, offs[j]:offs[j] + a, :], arity=a, sbox=sbox,
+            interpret=interpret)
+
+    seeds = last[:, None, :]
+    for j in range(f_lv):
+        seeds = level(seeds, j)                       # [B, F, 4]
+
+    def expand_fn(node_seeds):
+        s = node_seeds
+        for j in range(f_lv, len(ars)):
+            s = level(s, j)
+        return s[..., 0].astype(jnp.int32)            # [B, g*c]
+
+    return grouped_scan_contract(seeds, table_perm, expand_fn, f=f, c=c,
+                                 dot_impl=dot_impl)
+
+
+def _expand_contract_mixed_pallas_jit(cw1, cw2, last, table_perm, *, n,
+                                      prf_method, interpret, sbox=None,
+                                      dot_impl="i32"):
+    from ..ops.pallas_level import (pallas_chunk_leaves,
+                                    subtree_contract_pallas_mixed)
+    from .prf import PRF_AES128
+    if prf_method == PRF_AES128:
+        return _mixed_pallas_aes(cw1, cw2, last, table_perm, n=n,
+                                 sbox=sbox, interpret=interpret,
+                                 dot_impl=dot_impl)
+    ars = arities(n)
+    offs = cw_offsets(ars)
+    f_lv, _ = _suffix_chunk(ars, pallas_chunk_leaves(n))
+    seeds = last[:, None, :]
+    for j in range(f_lv):
+        seeds = _level_step_mixed(
+            seeds, cw1[:, offs[j]:offs[j] + ars[j], :],
+            cw2[:, offs[j]:offs[j] + ars[j], :], prf_method, ars[j])
+    return subtree_contract_pallas_mixed(
+        seeds, cw1, cw2, table_perm, ars=ars, f_lv=f_lv,
+        prf_method=prf_method, interpret=interpret)
+
+
+_PALLAS_JIT = None
+
+
+def expand_and_contract_mixed_pallas(cw1, cw2, last, table_perm, *, n: int,
+                                     prf_method: int, interpret=False,
+                                     aes_impl: str | None = None,
+                                     dot_impl: str = "i32"):
+    """Radix-4 fused evaluation on the Pallas kernels: ChaCha/Salsa ride
+    the phase-2 subtree kernel
+    (``ops/pallas_level.subtree_contract_pallas_mixed``), AES the
+    plane-domain level kernel (``ops/aes_planes``)."""
+    import functools
+    global _PALLAS_JIT
+    if _PALLAS_JIT is None:
+        import jax
+        _PALLAS_JIT = functools.partial(
+            jax.jit, static_argnames=("n", "prf_method", "interpret",
+                                      "sbox", "dot_impl")
+        )(_expand_contract_mixed_pallas_jit)
+    import jax.numpy as jnp
+    sbox = (aes_impl.split(":", 1)[1]
+            if aes_impl and ":" in aes_impl else None)
+    return _PALLAS_JIT(jnp.asarray(cw1), jnp.asarray(cw2),
+                       jnp.asarray(last), table_perm, n=n,
+                       prf_method=prf_method, interpret=interpret,
+                       sbox=sbox, dot_impl=dot_impl)
 
 
 _STEP_JIT = None  # module-level per-level jit (cached across batches)

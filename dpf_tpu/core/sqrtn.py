@@ -176,3 +176,85 @@ def eval_contract(keys: list, prf_method: int, table: np.ndarray):
     shares = jnp.stack([eval_grid(kk, prf_method, jnp) for kk in keys])
     from ..ops import matmul128
     return matmul128.dot(shares, jnp.asarray(table))
+
+
+def pack_sqrt_keys(keys: list) -> tuple:
+    """List of SqrtKey (uniform K, R) -> (seeds [B,K,4], cw1 [B,R,4],
+    cw2 [B,R,4]) uint32 arrays for the batched device path."""
+    k, r = keys[0].n_keys, keys[0].n_codewords
+    bsz = len(keys)
+    seeds = np.zeros((bsz, k, 4), dtype=np.uint32)
+    cw1 = np.zeros((bsz, r, 4), dtype=np.uint32)
+    cw2 = np.zeros((bsz, r, 4), dtype=np.uint32)
+    for i, kk in enumerate(keys):
+        if (kk.n_keys, kk.n_codewords) != (k, r):
+            raise ValueError("keys for mixed sqrt-N splits")
+        seeds[i] = kk.keys
+        cw1[i] = kk.cw1
+        cw2[i] = kk.cw2
+    return seeds, cw1, cw2
+
+
+def _eval_contract_batched_jit(seeds, cw1, cw2, table, *, prf_method,
+                               dot_impl):
+    import jax.numpy as jnp
+
+    from ..ops import matmul128
+
+    bsz, k, _ = seeds.shape
+    r = cw1.shape[1]
+    grid = jnp.broadcast_to(seeds[:, None, :, :], (bsz, r, k, 4))
+    rows = jnp.arange(r, dtype=jnp.uint32)[:, None]   # [R, 1] -> bcast
+    vals = prf_v(prf_method, grid, rows)              # [B, R, K, 4]
+    sel = (seeds[:, None, :, 0] & np.uint32(1)).astype(bool)[..., None]
+    cw = jnp.where(sel, cw2[:, :, None, :], cw1[:, :, None, :])
+    out = u128.add128(vals, cw)
+    shares = out[..., 0].astype(jnp.int32).reshape(bsz, r * k)
+    return matmul128.dot(shares, table, dot_impl)
+
+
+_BATCH_JIT = None
+
+
+def eval_contract_batched(seeds, cw1, cw2, table, *, prf_method: int,
+                          dot_impl: str = "i32"):
+    """Fused batched sqrt-N evaluation: one device program for the whole
+    batch — flat [B, R, K] PRF grid, LSB codeword select, 128-bit add,
+    exact mod-2^32 contraction against the natural-order table.
+
+    This is the production sqrt-N path (``eval_contract`` keeps the
+    per-key stacking for reference use): no level loop, no permutation —
+    the latency-friendly construction for mid-sized tables (the role the
+    reference's coop kernel plays for single queries,
+    ``dpf_gpu/dpf_coop.cu:3-9``).
+    """
+    import functools
+    global _BATCH_JIT
+    if _BATCH_JIT is None:
+        import jax
+        _BATCH_JIT = functools.partial(
+            jax.jit, static_argnames=("prf_method", "dot_impl")
+        )(_eval_contract_batched_jit)
+    import jax.numpy as jnp
+    return _BATCH_JIT(jnp.asarray(seeds), jnp.asarray(cw1),
+                      jnp.asarray(cw2), table, prf_method=prf_method,
+                      dot_impl=dot_impl)
+
+
+def eval_points_sqrt(keys: list, indices, prf_method: int):
+    """Sparse evaluation at the given indices: [B, Q] int32 shares.
+
+    Index x = r*K + j costs ONE PRF call (seed j at row r) — the sqrt-N
+    scheme's native strength; no tree walk at all.
+    """
+    idx = np.asarray(indices, dtype=np.int64)
+    out = np.zeros((len(keys), idx.size), dtype=np.int32)
+    prf = PRF_FUNCS[prf_method]
+    for i, kk in enumerate(keys):
+        for q, x in enumerate(idx):
+            r_i, j = divmod(int(x), kk.n_keys)
+            s = u128.limbs_to_int(kk.keys[j])
+            cw = kk.cw2[r_i] if (s & 1) else kk.cw1[r_i]
+            v = (prf(s, r_i) + u128.limbs_to_int(cw)) & MASK128
+            out[i, q] = np.int64(v & 0xFFFFFFFF).astype(np.int32)
+    return out

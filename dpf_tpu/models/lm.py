@@ -35,8 +35,10 @@ class LSTMLanguageModel(nn.Module):
         return nn.Dense(self.vocab_size)(h)
 
 
-def train_lm(ds: LMDataset, epochs=2, batch_size=32, lr=1e-2, seed=0):
-    model = LSTMLanguageModel(vocab_size=ds.vocab_size)
+def train_lm(ds: LMDataset, epochs=2, batch_size=32, lr=1e-2, seed=0,
+             embed_dim=32, hidden=64):
+    model = LSTMLanguageModel(vocab_size=ds.vocab_size,
+                              embed_dim=embed_dim, hidden=hidden)
     params = model.init(jax.random.PRNGKey(seed),
                         jnp.zeros((1, ds.seq_len), jnp.int32))
     tx = optax.adam(lr)

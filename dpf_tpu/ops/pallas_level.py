@@ -188,24 +188,29 @@ def chacha_level_step_pallas(seeds, cw1_lvl, cw2_lvl, interpret=False,
 # Fused subtree expand + contract (the production kernel)
 # ---------------------------------------------------------------------------
 
-def _make_subtree_kernel(levels: int, core=_chacha_core_planes):
+def _make_subtree_kernel(sched: tuple, core=_chacha_core_planes):
+    """Kernel over a per-level arity schedule.  ``sched[k]`` is the
+    fan-out of kernel level k; the sliced codeword arrays hold the levels'
+    slots back to back in the same order (see the wrapper's ``idx``)."""
     from jax.experimental import pallas as pl
 
     def kernel(seeds_ref, cw1_ref, cw2_ref, table_ref, out_ref):
         f = pl.program_id(1)
         planes = [seeds_ref[i] for i in range(4)]     # [TB, 1]
-        for k in range(levels):
+        off = 0
+        for a in sched:
             sel = (planes[0] & np.uint32(1)).astype(jnp.bool_)  # [TB, w]
             children = []
-            for b in (0, 1):
+            for b in range(a):
                 val = core(planes, np.uint32(b))
-                cw = [jnp.where(sel, cw2_ref[i, :, 2 * k + b][:, None],
-                                cw1_ref[i, :, 2 * k + b][:, None])
+                cw = [jnp.where(sel, cw2_ref[i, :, off + b][:, None],
+                                cw1_ref[i, :, off + b][:, None])
                       for i in range(4)]
                 children.append(_add128_planes(val, cw))
+            off += a
             w = planes[0].shape[1]
-            planes = [jnp.stack([children[0][i], children[1][i]],
-                                axis=2).reshape(-1, 2 * w)
+            planes = [jnp.stack([children[b][i] for b in range(a)],
+                                axis=2).reshape(-1, a * w)
                       for i in range(4)]
         leaves = planes[0].astype(jnp.int32)          # [TB, C]
         contrib = lax.dot_general(
@@ -228,6 +233,50 @@ PALLAS_TB = 32       # key tile (sublane-friendly multiple of 8)
 PALLAS_MAX_C = 4096  # leaves per subtree -> ~4 MB cipher state in VMEM
 
 
+def _subtree_contract_run(frontier, cw1, cw2, table_perm, *, idx, sched,
+                          prf_method, interpret, tb):
+    """Shared launcher: slice codeword slots (``idx``, level-major), pad
+    the batch to the key-tile multiple, run the schedule kernel."""
+    from jax.experimental import pallas as pl
+
+    bsz, f_cnt, _ = frontier.shape
+    n, e = table_perm.shape
+    c = n // f_cnt
+    assert c == int(np.prod(sched)), (c, sched)
+
+    tb = tb or min(PALLAS_TB, max(8, bsz))
+    pb = (-bsz) % tb
+    if pb:
+        frontier = jnp.pad(frontier, ((0, pb), (0, 0), (0, 0)))
+        cw1 = jnp.pad(cw1, ((0, pb), (0, 0), (0, 0)))
+        cw2 = jnp.pad(cw2, ((0, pb), (0, 0), (0, 0)))
+    bp = bsz + pb
+
+    n_slots = len(idx)
+    idx = np.asarray(idx)
+    cw1_sl = jnp.transpose(cw1[:, idx, :], (2, 0, 1))
+    cw2_sl = jnp.transpose(cw2[:, idx, :], (2, 0, 1))
+    seeds = jnp.transpose(frontier, (2, 0, 1))        # [4, B, F]
+    table_t = table_perm.T                            # [E, N]
+
+    grid = (bp // tb, f_cnt)
+    kernel = _make_subtree_kernel(tuple(sched), _CORES[prf_method])
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((4, tb, 1), lambda i, f: (0, i, f)),
+            pl.BlockSpec((4, tb, n_slots), lambda i, f: (0, i, 0)),
+            pl.BlockSpec((4, tb, n_slots), lambda i, f: (0, i, 0)),
+            pl.BlockSpec((e, c), lambda i, f: (0, f)),
+        ],
+        out_specs=pl.BlockSpec((tb, e), lambda i, f: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, e), jnp.int32),
+        interpret=interpret,
+    )(seeds, cw1_sl, cw2_sl, table_t)
+    return out[:bsz]
+
+
 @functools.partial(jax.jit, static_argnames=(
     "depth", "f_levels", "interpret", "tb", "prf_method"))
 def subtree_contract_pallas(frontier, cw1, cw2, table_perm, *,
@@ -239,50 +288,39 @@ def subtree_contract_pallas(frontier, cw1, cw2, table_perm, *,
     frontier:   [B, F, 4] u32 — phase-1 output seeds (subtree f of key b).
     cw1, cw2:   [B, 64, 4] u32 — full codeword arrays (wire layout).
     table_perm: [N, E] int32 — bit-reverse-permuted table, N = F * C.
-    prf_method: 2 = ChaCha20-12, 1 = Salsa20-12.
+    prf_method: 2 = ChaCha20-12, 1 = Salsa20-12 (for AES see
+    ``subtree_contract_pallas_aes``).
     Returns [B, E] int32 shares: sum_f leaves(f) . chunk(f).
     """
-    from jax.experimental import pallas as pl
-
-    bsz, f_cnt, _ = frontier.shape
-    n, e = table_perm.shape
-    c = n // f_cnt
     levels = depth - f_levels
-    assert c == 1 << levels, (c, levels)
-
-    tb = tb or min(PALLAS_TB, max(8, bsz))
-    pb = (-bsz) % tb
-    if pb:
-        frontier = jnp.pad(frontier, ((0, pb), (0, 0), (0, 0)))
-        cw1 = jnp.pad(cw1, ((0, pb), (0, 0), (0, 0)))
-        cw2 = jnp.pad(cw2, ((0, pb), (0, 0), (0, 0)))
-    bp = bsz + pb
-
     # phase-2 codeword slots, kernel level k = global flat level
-    # depth-1-(f_levels+k), branches adjacent: [4, B, 2*levels]
-    idx = np.array([2 * (depth - 1 - (f_levels + k)) + b
-                    for k in range(levels) for b in (0, 1)])
-    cw1_sl = jnp.transpose(cw1[:, idx, :], (2, 0, 1))
-    cw2_sl = jnp.transpose(cw2[:, idx, :], (2, 0, 1))
-    seeds = jnp.transpose(frontier, (2, 0, 1))        # [4, B, F]
-    table_t = table_perm.T                            # [E, N]
+    # depth-1-(f_levels+k), branches adjacent (binary wire layout 2i+b)
+    idx = [2 * (depth - 1 - (f_levels + k)) + b
+           for k in range(levels) for b in (0, 1)]
+    return _subtree_contract_run(
+        frontier, cw1, cw2, table_perm, idx=idx, sched=(2,) * levels,
+        prf_method=prf_method, interpret=interpret, tb=tb)
 
-    grid = (bp // tb, f_cnt)
-    kernel = _make_subtree_kernel(levels, _CORES[prf_method])
-    out = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((4, tb, 1), lambda i, f: (0, i, f)),
-            pl.BlockSpec((4, tb, 2 * levels), lambda i, f: (0, i, 0)),
-            pl.BlockSpec((4, tb, 2 * levels), lambda i, f: (0, i, 0)),
-            pl.BlockSpec((e, c), lambda i, f: (0, f)),
-        ],
-        out_specs=pl.BlockSpec((tb, e), lambda i, f: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bp, e), jnp.int32),
-        interpret=interpret,
-    )(seeds, cw1_sl, cw2_sl, table_t)
-    return out[:bsz]
+
+@functools.partial(jax.jit, static_argnames=(
+    "ars", "f_lv", "interpret", "tb", "prf_method"))
+def subtree_contract_pallas_mixed(frontier, cw1, cw2, table_perm, *,
+                                  ars: tuple, f_lv: int,
+                                  interpret=False, tb: int | None = None,
+                                  prf_method: int = 2):
+    """Mixed-radix (radix-4) variant: phase-2 covers eval levels
+    ``ars[f_lv:]`` with the mixed codeword layout (``radix4.cw_offsets``,
+    level-major slots).  Same VMEM-resident expand+contract as the binary
+    kernel; the wider fan-out means half the levels per subtree."""
+    from ..core.radix4 import cw_offsets
+
+    offs = cw_offsets(ars)
+    sched = tuple(ars[f_lv:])
+    idx = [offs[j] + b for j in range(f_lv, len(ars))
+           for b in range(ars[j])]
+    return _subtree_contract_run(
+        frontier, cw1, cw2, table_perm, idx=idx, sched=sched,
+        prf_method=prf_method, interpret=interpret, tb=tb)
 
 
 def pallas_chunk_leaves(n: int) -> int:

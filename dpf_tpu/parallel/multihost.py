@@ -19,26 +19,47 @@ from __future__ import annotations
 
 import numpy as np
 
+_initialized = False  # explicit module state: initialize() succeeded here
+
+
+def is_initialized() -> bool:
+    """True if this process's jax.distributed client is up (either via
+    ``initialize`` here or an earlier ``jax.distributed.initialize``)."""
+    if _initialized:
+        return True
+    try:  # reflect external initialization (e.g. a launcher did it)
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
                process_id: int | None = None):
-    """Initialize jax.distributed for multi-process runs.
+    """Initialize jax.distributed for multi-process runs (idempotent).
 
     With explicit arguments, failures propagate.  With no arguments,
     initialization is attempted unconditionally — on TPU pod slices JAX's
     cluster auto-detection supplies everything — and a detection failure
     (plain single-process run, tests) degrades to a no-op returning False.
     """
+    global _initialized
     import jax
+    if is_initialized():
+        return True
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
+        _initialized = True
         return True
     except Exception as e:
+        # belt-and-braces for external initialization on JAX versions
+        # where the private-state probe in is_initialized() is stale
         if "already initialized" in str(e).lower():
-            return True  # idempotent: an earlier component initialized it
+            _initialized = True
+            return True
         if (coordinator_address is not None or num_processes is not None
                 or process_id is not None or _cluster_expected()):
             raise  # a real cluster failed to initialize: surface it

@@ -97,6 +97,7 @@ def test_dpf_latency(N=16384, entrysize=16, prf=None, reps=20, quiet=False,
         "entries": N,
         "entry_size": entrysize,
         "prf": dpf.prf_method_string,
+        "scheme": getattr(dpf, "scheme", "logn"),
         "reps": reps,
         "latency_ms": round(1e3 * elapsed / reps, 3),
     }

@@ -22,6 +22,9 @@ class EvalConfig:
     radix: int = 2  # 2 = reference-wire-compatible binary GGM;
     #                 4 = TPU-native radix-4 (core/radix4.py): 2/3 the PRF
     #                 children, half the levels, 2x AES schedule amortization
+    scheme: str = "logn"  # "logn" (GGM tree, O(log N) keys) | "sqrtn"
+    #                 (core/sqrtn.py: O(sqrt N) keys, flat single-level PRF
+    #                 grid — the latency play for mid-sized tables)
 
     def with_(self, **kw) -> "EvalConfig":
         return replace(self, **kw)

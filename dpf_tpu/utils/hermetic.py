@@ -13,13 +13,18 @@ import os
 import re
 
 
-def force_cpu_mesh(n_devices: int = 8) -> None:
+def force_cpu_mesh(n_devices: int = 8, verify: bool = True) -> None:
     """Pin JAX to the CPU platform with ``n_devices`` virtual devices.
 
     Must be called before any JAX backend initialization (device query,
     compile, or array op).  Raises RuntimeError if a backend was already
     initialized in this process — the flags can no longer take effect and
     the caller needs a fresh process.
+
+    ``verify=False`` skips the final ``jax.default_backend()`` check —
+    that call itself initializes the backend, which must not happen yet
+    when the caller still has to run ``jax.distributed.initialize``
+    (multi-process tests); such callers verify after distributed init.
     """
     flags = os.environ.get("XLA_FLAGS", "")
     flag = "--xla_force_host_platform_device_count=%d" % n_devices
@@ -56,7 +61,7 @@ def force_cpu_mesh(n_devices: int = 8) -> None:
     # through to a backend query, which would itself initialize the
     # (possibly wedged) relay backend.
     jax.config.update("jax_platforms", "cpu")
-    if jax.default_backend() != "cpu":
+    if verify and jax.default_backend() != "cpu":
         raise RuntimeError(
             "failed to force the CPU platform: default backend is %r"
             % jax.default_backend())

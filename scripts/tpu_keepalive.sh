@@ -13,7 +13,7 @@ OUT=${1:-tpu_results.jsonl}
 STOP_FILE=${STOP_FILE:-/tmp/tpu_keepalive_stop}
 i=0
 while [ ! -f "$STOP_FILE" ]; do
-  if [ -f "$OUT" ] && grep -q '"stage": "session"' "$OUT"; then
+  if [ -f "$OUT" ] && grep -q '"done": true' "$OUT"; then
     echo "keepalive: session complete, exiting"
     break
   fi

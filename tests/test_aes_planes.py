@@ -1,0 +1,178 @@
+"""Plane-domain AES (ops/aes_planes.py): the Pallas-native bitsliced AES.
+
+Layers of validation, cheapest first:
+
+1. ``aes128_multi_planes`` as plain traced jnp (no Pallas) against the
+   scalar reference PRF — exercises the full cipher circuit + the
+   pack32/unpack32 key-row packing.
+2. The fused GGM level kernel in Pallas interpret mode against the
+   portable XLA level step (select + add128 + node-major interleave),
+   binary and radix-4.
+3. End-to-end ``kernel_impl="pallas"`` AES evaluation through the DPF
+   API vs the XLA path (small n to bound interpret-mode cost).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dpf_tpu.core import expand, keygen, prf_ref
+from dpf_tpu.ops import aes_planes
+
+
+def _plane_pack(seeds32):
+    """[32, W, 4] u32 -> 128 planes [1, W] (the in-kernel packing)."""
+    planes = []
+    for l in range(4):
+        rows = [seeds32[k:k + 1, :, l] for k in range(32)]
+        planes.extend(aes_planes.pack32(rows))
+    return planes
+
+
+def _plane_unpack(planes):
+    """128 planes [1, W] -> [32, W, 4] u32."""
+    limbs = []
+    for l in range(4):
+        rows = aes_planes.unpack32(planes[32 * l:32 * l + 32])
+        limbs.append(jnp.concatenate(rows, axis=0))
+    return jnp.stack(limbs, axis=-1)
+
+
+@pytest.mark.parametrize("n_pts", [2, 4])
+def test_aes_planes_matches_reference(n_pts):
+    rng = np.random.default_rng(7)
+    w = 3
+    seeds = rng.integers(0, 1 << 32, (32, w, 4), dtype=np.uint32)
+    planes = _plane_pack(jnp.asarray(seeds))
+    outs = aes_planes.aes128_multi_planes(planes, n_pts)
+    for b in range(n_pts):
+        got = np.asarray(_plane_unpack(outs[b]))
+        for k in range(32):
+            for j in range(w):
+                seed_int = sum(int(seeds[k, j, l]) << (32 * l)
+                               for l in range(4))
+                want = prf_ref.prf_aes128(seed_int, b)
+                want_limbs = [(want >> (32 * l)) & 0xFFFFFFFF
+                              for l in range(4)]
+                assert [int(x) for x in got[k, j]] == want_limbs, (
+                    b, k, j)
+
+
+@pytest.mark.parametrize("sbox", ["tower", "chain"])
+def test_aes_planes_sbox_variants(sbox):
+    """All three S-box circuits agree in plane domain (1 column)."""
+    rng = np.random.default_rng(11)
+    seeds = rng.integers(0, 1 << 32, (32, 1, 4), dtype=np.uint32)
+    planes = _plane_pack(jnp.asarray(seeds))
+    base = aes_planes.aes128_multi_planes(planes, 2, sbox=None)
+    alt = aes_planes.aes128_multi_planes(planes, 2, sbox=sbox)
+    for b in range(2):
+        assert (np.asarray(_plane_unpack(base[b]))
+                == np.asarray(_plane_unpack(alt[b]))).all()
+
+
+def _aes_level_case(arity, n_keys=2, w=2, kernel=True):
+    """Level step vs the portable path.
+
+    ``kernel=True`` runs the Mosaic kernel in interpret mode against the
+    non-Pallas ``aes_level_step_ref`` (identical math, cheap); the
+    ref-vs-portable-XLA leg is pinned separately by
+    ``test_aes_level_ref_matches_portable`` and the full-path tests, so
+    transitively kernel == portable without paying interpret cost twice.
+    """
+    rng = np.random.default_rng(3 + arity)
+    seeds = rng.integers(0, 1 << 32, (n_keys, w, 4), dtype=np.uint32)
+    cw1 = rng.integers(0, 1 << 32, (n_keys, arity, 4), dtype=np.uint32)
+    cw2 = rng.integers(0, 1 << 32, (n_keys, arity, 4), dtype=np.uint32)
+
+    ref = np.asarray(aes_planes.aes_level_step_ref(
+        jnp.asarray(seeds), jnp.asarray(cw1), jnp.asarray(cw2),
+        arity=arity))
+    if kernel:
+        got = np.asarray(aes_planes.aes_level_step_pallas(
+            jnp.asarray(seeds), jnp.asarray(cw1), jnp.asarray(cw2),
+            arity=arity, interpret=True, tw=2))
+        assert (got == ref).all()
+        return
+
+    # portable reference: select by LSB, add128, node-major interleave
+    from dpf_tpu.core import u128
+    from dpf_tpu.core.prf import prf_multi
+    outs = prf_multi(3, jnp.asarray(seeds), arity,
+                     aes_impl="bitsliced:bp")
+    sel = (seeds[..., 0] & 1).astype(bool)[..., None]
+    children = []
+    for b in range(arity):
+        cw = np.where(sel, cw2[:, None, b, :], cw1[:, None, b, :])
+        children.append(np.asarray(u128.add128(np.asarray(outs[b]), cw)))
+    want = np.stack(children, axis=2).reshape(n_keys, arity * w, 4)
+    assert (ref == want).all()
+
+
+@pytest.mark.parametrize("arity", [2, 4])
+def test_aes_level_ref_matches_portable(arity):
+    _aes_level_case(arity, kernel=False)
+
+
+def test_aes_level_kernel_binary():
+    _aes_level_case(2)
+
+
+def test_aes_level_kernel_radix4():
+    _aes_level_case(4)
+
+
+def _ref_step(*a, **kw):
+    """aes_level_step_pallas stand-in: identical math, no Mosaic.
+
+    Interpret-mode Pallas inside the full jitted driver blows up XLA-CPU
+    compile time/memory; the kernel itself is asserted against this ref
+    in the small interpret tests above, so the full-path tests swap it in
+    and exercise all the driver glue (cw slicing, grouping, scan, dot).
+    """
+    kw.pop("interpret", None)
+    kw.pop("tw", None)
+    return aes_planes.aes_level_step_ref(*a, **kw)
+
+
+def test_pallas_aes_full_path_binary(monkeypatch):
+    """kernel_impl='pallas' + AES through the DPF API vs the XLA path."""
+    import dpf_tpu
+    from dpf_tpu.utils.config import EvalConfig
+
+    monkeypatch.setattr(aes_planes, "aes_level_step_pallas", _ref_step)
+
+    n = 128
+    cfg = EvalConfig(prf_method=dpf_tpu.PRF_AES128, kernel_impl="pallas",
+                     chunk_leaves=32)
+    d = dpf_tpu.DPF(config=cfg)
+    ref = dpf_tpu.DPF(prf=dpf_tpu.PRF_AES128)
+    table = np.arange(n * 4, dtype=np.int32).reshape(n, 4)
+    d.eval_init(table)
+    ref.eval_init(table)
+    keys = [d.gen(7, n)[0], d.gen(100, n)[1]]
+    got = np.asarray(d.eval_tpu(keys))
+    want = np.asarray(ref.eval_tpu(keys))
+    assert (got == want).all()
+
+
+def test_pallas_aes_full_path_radix4(monkeypatch):
+    import dpf_tpu
+    from dpf_tpu.utils.config import EvalConfig
+
+    monkeypatch.setattr(aes_planes, "aes_level_step_pallas", _ref_step)
+
+    n = 256
+    cfg = EvalConfig(prf_method=dpf_tpu.PRF_AES128, kernel_impl="pallas",
+                     radix=4)
+    d = dpf_tpu.DPF(config=cfg)
+    ref = dpf_tpu.DPF(config=EvalConfig(prf_method=dpf_tpu.PRF_AES128,
+                                        radix=4))
+    table = np.arange(n * 4, dtype=np.int32).reshape(n, 4)
+    d.eval_init(table)
+    ref.eval_init(table)
+    keys = [d.gen(7, n)[0], d.gen(200, n)[1]]
+    got = np.asarray(d.eval_tpu(keys))
+    want = np.asarray(ref.eval_tpu(keys))
+    assert (got == want).all()

@@ -1,0 +1,48 @@
+"""Two-process jax.distributed test on the CPU backend (VERDICT r2 #8).
+
+The TPU answer to "multi-node without a cluster": two OS processes form a
+real jax.distributed cluster over localhost (coordinator + worker), build
+one global ("batch", "table") mesh spanning both processes' virtual CPU
+devices, and run a table-sharded DPF evaluation whose psum crosses the
+process boundary.  Each worker asserts recovery and prints MULTIHOST_OK.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+WORKER = os.path.join(HERE, "multihost_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_distributed_mesh():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker pins its own device count
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen(
+        [sys.executable, WORKER, str(rank), str(port)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, env=env,
+        text=True) for rank in (0, 1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            p.kill()
+        pytest.fail("multihost workers timed out; outputs so far: %r"
+                    % outs)
+    for rank, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, "rank %d failed:\n%s" % (rank, out)
+        assert "MULTIHOST_OK %d" % rank in out, out

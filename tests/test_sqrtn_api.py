@@ -1,0 +1,102 @@
+"""Sqrt-N scheme surfaced through the DPF API (EvalConfig(scheme=...)).
+
+The construction itself is exhaustively tested in test_sqrtn.py; these
+tests cover the API plumbing: gen/eval_init/eval_tpu/eval_cpu/
+eval_one_hot/eval_points with sqrt-N keys, plus recovery parity with the
+log-N scheme on the same table.
+"""
+
+import numpy as np
+import pytest
+
+import dpf_tpu
+from dpf_tpu.utils.config import EvalConfig
+
+
+def _pair(prf=None, **kw):
+    cfg = EvalConfig(prf_method=dpf_tpu.PRF_CHACHA20 if prf is None
+                     else prf, scheme="sqrtn", **kw)
+    return dpf_tpu.DPF(config=cfg)
+
+
+def test_sqrtn_recovery_end_to_end():
+    n, e = 256, 5
+    d = _pair()
+    table = np.arange(n * e, dtype=np.int32).reshape(n, e)
+    d.eval_init(table)
+    k0, k1 = d.gen(171, n)
+    out = np.asarray(d.eval_tpu([k0, k1]))
+    rec = (out[0].astype(np.int64) - out[1].astype(np.int64)) % (1 << 32)
+    assert (rec.astype(np.uint32).astype(np.int32) == table[171]).all()
+
+
+def test_sqrtn_matches_logn_outputs_shape_and_recovery():
+    n = 128
+    sq = _pair()
+    lg = dpf_tpu.DPF(prf=dpf_tpu.PRF_CHACHA20)
+    table = np.random.default_rng(0).integers(
+        -2 ** 31, 2 ** 31, (n, 16), dtype=np.int32)
+    sq.eval_init(table)
+    lg.eval_init(table)
+    for alpha in (0, 63, 127):
+        a0, a1 = sq.gen(alpha, n)
+        b0, b1 = lg.gen(alpha, n)
+        sa = np.asarray(sq.eval_tpu([a0, a1]))
+        sb = np.asarray(lg.eval_tpu([b0, b1]))
+        ra = (sa[0] - sa[1]).astype(np.int32)
+        rb = (sb[0] - sb[1]).astype(np.int32)
+        assert (ra == rb).all() and (ra == table[alpha]).all()
+
+
+def test_sqrtn_eval_cpu_and_one_hot():
+    n = 128
+    d = _pair()
+    table = np.arange(n * 3, dtype=np.int32).reshape(n, 3)
+    d.eval_init(table)
+    k0, k1 = d.gen(7, n)
+    hots = d.eval_cpu([k0, k1], one_hot_only=True)
+    diff = (np.asarray(hots[0]).astype(np.int64)
+            - np.asarray(hots[1]).astype(np.int64))
+    want = np.zeros(n, dtype=np.int64)
+    want[7] = 1
+    assert (diff == want).all()
+    oh = d.eval_one_hot([k0, k1])
+    assert (np.asarray(oh) == np.asarray(hots)).all()
+    cpu = np.asarray(d.eval_cpu([k0, k1]))
+    tpu = np.asarray(d.eval_tpu([k0, k1]))
+    assert (cpu == tpu).all()
+
+
+def test_sqrtn_eval_points():
+    n = 256
+    d = _pair(prf=dpf_tpu.PRF_SALSA20)
+    k0, k1 = d.gen(99, n)
+    idx = [0, 98, 99, 100, 255]
+    p = np.asarray(d.eval_points([k0, k1], idx))
+    diff = (p[0].astype(np.int64) - p[1].astype(np.int64)) & 0xFFFFFFFF
+    assert diff.tolist() == [0, 0, 1, 0, 0]
+
+
+def test_sqrtn_rejects_radix4_and_bad_scheme():
+    with pytest.raises(ValueError, match="radix"):
+        dpf_tpu.DPF(config=EvalConfig(scheme="sqrtn", radix=4))
+    with pytest.raises(ValueError, match="scheme"):
+        dpf_tpu.DPF(config=EvalConfig(scheme="cube"))
+
+
+def test_sqrtn_key_sizes_scale_as_sqrt():
+    d = _pair()
+    k0, _ = d.gen(0, 1 << 14)
+    # K = 128, R = 128 -> 4 + 128 + 256 slots * 16 B
+    assert np.asarray(k0).size == (4 + 128 + 256) * 4
+
+
+def test_sqrtn_aes_small():
+    n = 128
+    d = _pair(prf=dpf_tpu.PRF_AES128)
+    table = np.arange(n * 2, dtype=np.int32).reshape(n, 2)
+    d.eval_init(table)
+    k0, k1 = d.gen(42, n)
+    out = np.asarray(d.eval_tpu([k0, k1]))
+    rec = (out[0] - out[1]).astype(np.int32)
+    assert (rec == table[42]).all()
