@@ -94,7 +94,11 @@ def main():
         c.apply_globals()
         return c
 
-    def perf(stage, n, batch, prf, reps=5, check=False, **kw):
+    def perf(stage, n, batch, prf, reps=5, check=True, **kw):
+        # check=True everywhere by default: every recorded throughput row
+        # passes the exact share-recovery gate before timing, so any row
+        # is eligible as the headline (bench.py filters on ``checked``).
+        # Cost is ~2 extra evals per point against a shared compile.
         cfg = cfg_for(prf, batch, **kw)
         r = test_dpf_perf(N=n, batch=batch, prf=prf, reps=reps,
                           quiet=True, check=check, config=cfg,
@@ -122,47 +126,58 @@ def main():
 
     # ---- tuning sweep ----
     if "tuning" in stages:
+        aes_rows = []  # (result, kw) of every AES-headline-shaped point
+
+        def tune(n, batch, prf, **kw):
+            r = guard("tuning", perf, "tuning", n, batch, prf, reps=5, **kw)
+            if (r and prf == dpf_tpu.PRF_AES128 and n == 65536
+                    and batch == 512):
+                aes_rows.append((r, kw))
+            return r
+
         for aes_impl, unroll in itertools.product(
                 ("bitsliced:bp", "bitsliced:tower", "gather"),
                 (False, True)):
-            guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_AES128,
-                  reps=5, aes_impl=aes_impl, round_unroll=unroll,
-                  kernel_impl="dispatch")
+            tune(65536, 512, dpf_tpu.PRF_AES128,
+                 aes_impl=aes_impl, round_unroll=unroll,
+                 kernel_impl="dispatch")
         for unroll, dot in itertools.product((False, True), ("i32", "mxu")):
-            guard("tuning", perf, "tuning", 65536, 512,
-                  dpf_tpu.PRF_CHACHA20, kernel_impl="xla",
-                  round_unroll=unroll, dot_impl=dot)
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_CHACHA20,
-              kernel_impl="dispatch")
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_CHACHA20,
-              kernel_impl="pallas")
+            tune(65536, 512, dpf_tpu.PRF_CHACHA20, kernel_impl="xla",
+                 round_unroll=unroll, dot_impl=dot)
+        tune(65536, 512, dpf_tpu.PRF_CHACHA20, kernel_impl="dispatch")
+        tune(65536, 512, dpf_tpu.PRF_CHACHA20, kernel_impl="pallas")
         for unroll, dot in itertools.product((False, True), ("i32", "mxu")):
-            guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_SALSA20,
-                  round_unroll=unroll, dot_impl=dot)
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_SALSA20,
-              kernel_impl="pallas")
+            tune(65536, 512, dpf_tpu.PRF_SALSA20,
+                 round_unroll=unroll, dot_impl=dot)
+        tune(65536, 512, dpf_tpu.PRF_SALSA20, kernel_impl="pallas")
         # radix-4 construction (core/radix4.py): 2/3 the PRF children,
         # half the levels, 2x AES schedule amortization — vs binary above
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_AES128,
-              radix=4, aes_impl="bitsliced:bp", round_unroll=False,
-              kernel_impl="dispatch")
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_AES128,
-              radix=4, aes_impl="bitsliced:bp", round_unroll=True,
-              kernel_impl="dispatch")
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_CHACHA20,
-              radix=4)
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_SALSA20,
-              radix=4)
+        tune(65536, 512, dpf_tpu.PRF_AES128,
+             radix=4, aes_impl="bitsliced:bp", round_unroll=False,
+             kernel_impl="dispatch")
+        tune(65536, 512, dpf_tpu.PRF_AES128,
+             radix=4, aes_impl="bitsliced:bp", round_unroll=True,
+             kernel_impl="dispatch")
+        tune(65536, 512, dpf_tpu.PRF_CHACHA20, radix=4)
+        tune(65536, 512, dpf_tpu.PRF_SALSA20, radix=4)
         # plane-domain Pallas AES level kernel (ops/aes_planes.py):
         # compiles as one small Mosaic program per level (relay-safe),
         # A/B vs the XLA bitsliced dispatch path above
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_AES128,
-              kernel_impl="pallas", aes_impl="bitsliced:bp")
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_AES128,
-              kernel_impl="pallas", aes_impl="bitsliced:bp", radix=4)
+        tune(65536, 512, dpf_tpu.PRF_AES128,
+             kernel_impl="pallas", aes_impl="bitsliced:bp")
+        tune(65536, 512, dpf_tpu.PRF_AES128,
+             kernel_impl="pallas", aes_impl="bitsliced:bp", radix=4)
         # radix-4 ChaCha on the mixed-arity Pallas subtree kernel
-        guard("tuning", perf, "tuning", 65536, 512, dpf_tpu.PRF_CHACHA20,
-              kernel_impl="pallas", radix=4)
+        tune(65536, 512, dpf_tpu.PRF_CHACHA20, kernel_impl="pallas",
+             radix=4)
+        # Re-measure the AES-headline winner at headline reps as a
+        # "headline" row: bench.py prefers headline rows over raw sweep
+        # rows, keeping the round-over-round metric definition fixed
+        # ("best verified config, re-measured").
+        if aes_rows:
+            _, best_kw = max(aes_rows, key=lambda t: t[0]["dpfs_per_sec"])
+            guard("headline", perf, "headline", 65536, 512,
+                  dpf_tpu.PRF_AES128, reps=10, **best_kw)
 
     # ---- README-style throughput table ----
     if "table" in stages:

@@ -11,12 +11,82 @@ set -u
 cd "$(dirname "$0")/.."
 OUT=${1:-tpu_results.jsonl}
 STOP_FILE=${STOP_FILE:-/tmp/tpu_keepalive_stop}
+
+# Single-instance guard (round-4 incident, docs/STATUS.md): two of these
+# loops ran concurrently for ~7 h, interleaving claimants on the relay.
+# flock on a well-known lock file makes a second start a no-op.
+LOCK_FILE=${LOCK_FILE:-/tmp/tpu_keepalive.lock}
+if command -v flock > /dev/null 2>&1; then
+  exec 9> "$LOCK_FILE"
+  if ! flock -n 9; then
+    echo "keepalive: another instance holds $LOCK_FILE; refusing to start" >&2
+    exit 1
+  fi
+else
+  # mkdir fallback: PID-stamped so a SIGKILL'd holder's stale lock
+  # self-heals.  Stale recovery is race-free: mv is atomic, so of two
+  # concurrent recoverers exactly one renames the stale dir away and
+  # the loser's mkdir decides against whoever re-creates first.  An
+  # empty/unreadable pid file is treated as a LIVE holder (refuse):
+  # fail-safe during the mkdir->echo window.
+  if ! mkdir "$LOCK_FILE.d" 2> /dev/null; then
+    holder=$(cat "$LOCK_FILE.d/pid" 2> /dev/null || echo "")
+    if [ -z "$holder" ] || kill -0 "$holder" 2> /dev/null; then
+      echo "keepalive: pid '${holder:-?}' holds $LOCK_FILE.d; refusing" >&2
+      exit 1
+    fi
+    if mv "$LOCK_FILE.d" "$LOCK_FILE.d.stale.$$" 2> /dev/null; then
+      echo "keepalive: cleared stale lock (holder $holder dead)" >&2
+      rm -rf "$LOCK_FILE.d.stale.$$"
+    fi
+    if ! mkdir "$LOCK_FILE.d" 2> /dev/null; then
+      echo "keepalive: lost stale-lock recovery race; refusing" >&2
+      exit 1
+    fi
+  fi
+  echo $$ > "$LOCK_FILE.d/pid"
+  trap 'rm -rf "$LOCK_FILE.d"' EXIT
+fi
+
+# Live-claimant scan: exact argv-token matching via /proc, and the
+# process must BE an interpreter (python running tpu_all.py, python
+# running a bench worker) — an editor/tail/grep holding a script path,
+# or a shell -c blob mentioning one, must not match (same rule as
+# bench.py's _other_claimant).  Fallback only: the flock above is the
+# principal mutual exclusion (bench.py takes the same lock).
+foreign_claimant() {
+  for d in /proc/[0-9]*; do
+    [ "$d" = "/proc/$$" ] && continue
+    [ -r "$d/cmdline" ] || continue
+    case "$(cat "$d/comm" 2> /dev/null)" in python*) ;; *) continue ;; esac
+    toks=$(tr '\0' '\n' < "$d/cmdline" 2> /dev/null)
+    [ -n "$toks" ] || continue
+    if printf '%s\n' "$toks" | grep -qxE '(.*/)?tpu_all\.py'; then
+      echo "$d tpu_all.py"
+      return 0
+    fi
+    if printf '%s\n' "$toks" | grep -qxF -- '--run-worker' \
+        && printf '%s\n' "$toks" | grep -qxE '(.*/)?bench\.py'; then
+      echo "$d bench.py --run-worker"
+      return 0
+    fi
+  done
+  return 1
+}
+
 i=0
 while [ ! -f "$STOP_FILE" ]; do
   if [ -f "$OUT" ] && grep -q '"done": true' "$OUT"; then
     echo "keepalive: session complete, exiting"
     break
   fi
+  # re-scan EVERY iteration: a claimant that appeared mid-loop (e.g. a
+  # bench.py --live worker) must not be joined by the next launch
+  c=$(foreign_claimant) && {
+    echo "keepalive: live TPU claimant ($c); waiting" >> tpu_keepalive.log
+    sleep 90
+    continue
+  }
   i=$((i + 1))
   echo "keepalive: attempt $i at $(date -u +%H:%M:%S)" >> tpu_keepalive.log
   python experiments/tpu_all.py --out "$OUT" >> tpu_keepalive.log 2>&1

@@ -1,0 +1,195 @@
+"""Driver-protocol tests for ``bench.py`` (no TPU, no relay claim).
+
+The round-3 failure mode being locked in: the driver runs ``bench.py``
+while the measurement keepalive may still be claiming the relay; the
+script must (a) report an already-measured headline row from
+``tpu_results.jsonl`` without touching the backend, and (b) refuse to
+spawn a second claimant next to a live one.
+"""
+
+import importlib.util
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_driver_mod", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _rows(tmp_path, rows):
+    p = tmp_path / "tpu_results.jsonl"
+    with open(p, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    return str(p)
+
+
+HEAD = {"stage": "headline", "entries": 65536, "prf": "AES128",
+        "batch_size": 512, "dpfs_per_sec": 17000, "t": 1.0,
+        "elapsed_s": 0.30, "checked": True}
+
+
+def test_cached_headline_picks_best_matching_row(tmp_path):
+    m = _load_bench()
+    p = _rows(tmp_path, [
+        HEAD,
+        {"stage": "tuning", "entries": 65536, "prf": "AES128",
+         "batch_size": 512, "dpfs_per_sec": 21000, "t": 2.0,
+         "knobs": {"radix": 4}, "checked": True},
+        # ungated row: fast but never recovery-checked -> ineligible
+        {"stage": "tuning", "entries": 65536, "prf": "AES128",
+         "batch_size": 512, "dpfs_per_sec": 44000, "t": 2.5,
+         "checked": False},
+        # wrong PRF / wrong N / wrong batch: never the headline
+        {"stage": "table", "entries": 65536, "prf": "CHACHA20",
+         "batch_size": 512, "dpfs_per_sec": 99000, "t": 3.0,
+         "checked": True},
+        {"stage": "table", "entries": 16384, "prf": "AES128",
+         "batch_size": 512, "dpfs_per_sec": 52000, "t": 4.0,
+         "checked": True},
+        {"stage": "tuning", "entries": 65536, "prf": "AES128",
+         "batch_size": 64, "dpfs_per_sec": 88000, "t": 5.0,
+         "checked": True},
+    ])
+    # headline rows outrank raw sweep rows (fixed metric definition:
+    # the session re-measures its tuning winner as a headline row)
+    best = m._cached_headline(65536, p, since=0)
+    assert best["dpfs_per_sec"] == 17000 and best["stage"] == "headline"
+    # with no headline row, the best checked tuning/table row wins
+    assert m._cached_headline(16384, p, since=0)["dpfs_per_sec"] == 52000
+    assert m._cached_headline(262144, p, since=0) is None
+
+
+def test_cached_headline_tuning_fallback_prefers_fastest(tmp_path):
+    m = _load_bench()
+    p = _rows(tmp_path, [
+        {"stage": "tuning", "entries": 65536, "prf": "AES128",
+         "batch_size": 512, "dpfs_per_sec": 15000, "t": 1.0,
+         "checked": True},
+        {"stage": "tuning", "entries": 65536, "prf": "AES128",
+         "batch_size": 512, "dpfs_per_sec": 21000, "t": 2.0,
+         "knobs": {"radix": 4}, "checked": True},
+    ])
+    assert m._cached_headline(65536, p, since=0)["dpfs_per_sec"] == 21000
+
+
+def test_cached_headline_rejects_previous_round_rows(tmp_path):
+    m = _load_bench()
+    p = _rows(tmp_path, [HEAD])  # measured at t=1.0
+    assert m._cached_headline(65536, p, since=0) is not None
+    assert m._cached_headline(65536, p, since=2.0) is None
+
+
+def test_round_start_t_reads_progress_log():
+    m = _load_bench()
+    t = m._round_start_t(REPO)
+    # PROGRESS.jsonl exists in this repo and has multiple rounds; the
+    # current round's start must be later than round 1's first entry
+    if t is not None:
+        with open(os.path.join(REPO, "PROGRESS.jsonl")) as f:
+            first = json.loads(f.readline())
+        assert t >= first["ts"]
+
+
+def test_cached_headline_tolerates_garbage_and_absence(tmp_path):
+    m = _load_bench()
+    assert m._cached_headline(65536, str(tmp_path / "missing.jsonl"),
+                              since=0) is None
+    p = _rows(tmp_path, [])
+    with open(p, "a") as f:
+        f.write("not json at all\n{\"stage\": \"truncated\n")
+        f.write("123\nnull\n[1, 2]\n")  # valid JSON, not objects
+        f.write(json.dumps({"stage": "tuning", "entries": 65536,
+                            "prf": "AES128", "batch_size": 512,
+                            "dpfs_per_sec": "fast", "checked": True,
+                            "t": 9.0}) + "\n")  # wrongly-typed field
+    assert m._cached_headline(65536, p, since=0) is None
+
+
+def test_cached_headline_fails_closed_without_round_marker(tmp_path):
+    """No PROGRESS.jsonl next to bench.py in the repo checkout scenario
+    is covered by main() tests (tmp copies get one); here: since=None
+    and an unreadable round boundary must reject the cache."""
+    m = _load_bench()
+    p = _rows(tmp_path, [HEAD])
+    # since defaults to the real repo's PROGRESS.jsonl round start,
+    # which is far later than t=1.0 -> rejected either way; with an
+    # explicit epoch it is accepted.  (The no-PROGRESS case is exercised
+    # through a tmp copy below.)
+    assert m._cached_headline(65536, p) is None
+    assert m._cached_headline(65536, p, since=0) is not None
+
+
+def test_main_fails_closed_without_progress_file(tmp_path):
+    """A bench.py copy with a results row but NO PROGRESS.jsonl must not
+    trust the cache (round boundary unknown) — it falls through to the
+    claimant check; a fake claimant keeps the test off the backend."""
+    dst = tmp_path / "bench.py"
+    shutil.copy(os.path.join(REPO, "bench.py"), dst)
+    _rows(tmp_path, [HEAD])
+    fake = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)",
+         "bench.py", "65536", "--run-worker"])
+    try:
+        time.sleep(0.2)
+        r = subprocess.run([sys.executable, str(dst)],
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 2, (r.stdout, r.stderr)
+        rec = json.loads(r.stdout.strip().splitlines()[-1])
+        assert rec["value"] == 0
+    finally:
+        fake.kill()
+        fake.wait()
+
+
+def _bench_copy(tmp_path, rows=None):
+    """bench.py resolves tpu_results.jsonl + PROGRESS.jsonl next to
+    itself; give the test its own copies so the repo artifacts are never
+    touched.  The PROGRESS file marks a round starting at ts=0.5 so the
+    HEAD row (t=1.0) counts as this-round."""
+    dst = tmp_path / "bench.py"
+    shutil.copy(os.path.join(REPO, "bench.py"), dst)
+    with open(tmp_path / "PROGRESS.jsonl", "w") as f:
+        f.write(json.dumps({"ts": 0.5, "round": 1}) + "\n")
+    if rows is not None:
+        _rows(tmp_path, rows)
+    return str(dst)
+
+
+def test_main_reports_cached_row_without_backend(tmp_path):
+    script = _bench_copy(tmp_path, rows=[HEAD])
+    r = subprocess.run([sys.executable, script], capture_output=True,
+                       text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["value"] == 17000
+    assert rec["vs_baseline"] == round(17000 / 15392.0, 4)
+    assert "tpu_results.jsonl" in rec["source"]
+
+
+def test_main_refuses_second_claimant(tmp_path):
+    script = _bench_copy(tmp_path, rows=None)  # no cached headline
+    fake = subprocess.Popen(
+        [sys.executable, "-c", "import time; time.sleep(60)",
+         "bench.py", "65536", "--run-worker"])
+    try:
+        time.sleep(0.2)
+        r = subprocess.run([sys.executable, script], capture_output=True,
+                           text=True, timeout=60)
+        assert r.returncode == 2, (r.stdout, r.stderr)
+        rec = json.loads(r.stdout.strip().splitlines()[-1])
+        assert rec["value"] == 0
+        assert "refusing a second concurrent claim" in rec["error"]
+    finally:
+        fake.kill()
+        fake.wait()
