@@ -246,7 +246,7 @@ def main():
     if "--live" not in sys.argv:
         cached = _cached_headline(n)
         if cached:
-            _result(cached["dpfs_per_sec"], n, {
+            _result(float(cached["dpfs_per_sec"]), n, {
                 "source": "tpu_results.jsonl (single-claim TPU session, "
                           "experiments/tpu_all.py)",
                 "measured_unix_t": cached.get("t"),

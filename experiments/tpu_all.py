@@ -57,7 +57,11 @@ def main():
         rec = dict(rec)
         rec["stage"] = stage
         rec["t"] = round(time.time(), 1)
-        if stage != "session" and "error" not in rec and "skipped" not in rec:
+        # probe doesn't count: a session where only the tiny probe ran
+        # but every measurement stage errored must NOT mark done:true
+        # (the keepalive loop would stop retrying with zero data)
+        if (stage not in ("session", "probe") and "error" not in rec
+                and "skipped" not in rec):
             n_ok[0] += 1
         line = json.dumps(rec)
         out.write(line + "\n")

@@ -1,0 +1,281 @@
+#!/usr/bin/env python
+"""Render measured TPU results into the judged artifacts.
+
+Turns ``tpu_results.jsonl`` (appended by the single-claim session,
+``experiments/tpu_all.py``) into:
+
+* ``docs/MEASURED.md`` — full measured tables: headline, throughput vs
+  the reference's published V100/P100 numbers (``/root/reference/
+  README.md:102-146``, mirrored in BASELINE.md), single-query latency,
+  large-N, tuning-sweep winners, PRF zoo, contraction microbench.
+* the ``<!-- MEASURED:BEGIN -->`` .. ``<!-- MEASURED:END -->`` block in
+  ``README.md`` — headline + throughput summary.
+
+Run it any time (idempotent); the keepalive loop runs it after a session
+completes so a relay recovery at any hour still yields the artifacts.
+
+  python scripts/report.py [--results tpu_results.jsonl] [--no-readme]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Reference-published dpfs/sec (BASELINE.md; reference README.md:102-146).
+V100 = {
+    ("AES128", 16384): 52536, ("AES128", 65536): 15392,
+    ("AES128", 262144): 3967, ("AES128", 1048576): 923,
+    ("SALSA20", 16384): 145646, ("SALSA20", 65536): 54892,
+    ("SALSA20", 262144): 16650, ("SALSA20", 1048576): 3894,
+    ("CHACHA20", 16384): 139590, ("CHACHA20", 65536): 56120,
+    ("CHACHA20", 262144): 16086, ("CHACHA20", 1048576): 4054,
+}
+P100 = {
+    ("AES128", 16384): 23954, ("AES128", 65536): 6131,
+    ("AES128", 262144): 1443, ("AES128", 1048576): 379,
+    ("SALSA20", 16384): 76073, ("SALSA20", 65536): 23141,
+    ("SALSA20", 262144): 5849, ("SALSA20", 1048576): 1447,
+    ("CHACHA20", 16384): 75679, ("CHACHA20", 65536): 22433,
+    ("CHACHA20", 262144): 5830, ("CHACHA20", 1048576): 1424,
+}
+
+
+def round_start_t(repo):
+    """Current round's start time from PROGRESS.jsonl, or None (same
+    boundary bench.py uses): the rendered artifacts must reflect THIS
+    round's measurements, not the all-time best from the append-only
+    results file (a stale fast row would mask a later regression)."""
+    starts = {}
+    try:
+        with open(os.path.join(repo, "PROGRESS.jsonl")) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                    starts.setdefault(int(r["round"]), float(r["ts"]))
+                except (ValueError, KeyError, TypeError):
+                    continue
+    except OSError:
+        return None
+    return starts[max(starts)] if starts else None
+
+
+def load(path, since=None):
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if not isinstance(r, dict):
+                    continue
+                try:
+                    if since is not None and float(r.get("t", 0)) < since:
+                        continue
+                except (TypeError, ValueError):
+                    continue
+                rows.append(r)
+    except OSError:
+        pass
+    return rows
+
+
+def _write_atomic(path, text):
+    tmp = path + ".tmp.%d" % os.getpid()
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+
+
+def best_by(rows, keyf, pred):
+    out = {}
+    for r in rows:
+        try:
+            if not pred(r):
+                continue
+            k = keyf(r)
+            if k not in out or (r["dpfs_per_sec"]
+                                > out[k]["dpfs_per_sec"]):
+                out[k] = r
+        except (KeyError, TypeError):
+            continue
+    return out
+
+
+def fmt_knobs(r):
+    kn = r.get("knobs") or {}
+    if not kn:
+        return "defaults"
+    return ",".join("%s=%s" % (k, v) for k, v in sorted(kn.items()))
+
+
+def throughput_table(rows):
+    """(lines, best-per-cell dict) for the README-style table."""
+    checked = best_by(
+        rows,
+        lambda r: (r["prf"], r["entries"]),
+        lambda r: (r.get("stage") in ("headline", "tuning", "table")
+                   and r.get("checked") and r.get("batch_size") == 512
+                   and r.get("dpfs_per_sec")))
+    if not checked:
+        return [], {}
+    ns = sorted({n for _, n in checked})
+    lines = ["| Entries | PRF | TPU v5e (this repo) | V100 (ref) | "
+             "vs V100 | P100 (ref) | vs P100 | config |",
+             "|---|---|---|---|---|---|---|---|"]
+    for n in ns:
+        for prf in ("AES128", "SALSA20", "CHACHA20"):
+            r = checked.get((prf, n))
+            if not r:
+                continue
+            v, p = V100.get((prf, n)), P100.get((prf, n))
+            lines.append(
+                "| %d | %s | **%d** | %s | %s | %s | %s | %s |" % (
+                    n, prf, r["dpfs_per_sec"],
+                    v or "—",
+                    "%.2fx" % (r["dpfs_per_sec"] / v) if v else "—",
+                    p or "—",
+                    "%.2fx" % (r["dpfs_per_sec"] / p) if p else "—",
+                    fmt_knobs(r)))
+    return lines, checked
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--results",
+                    default=os.path.join(REPO, "tpu_results.jsonl"))
+    ap.add_argument("--out-doc",
+                    default=os.path.join(REPO, "docs", "MEASURED.md"))
+    ap.add_argument("--readme", default=os.path.join(REPO, "README.md"))
+    ap.add_argument("--no-readme", action="store_true")
+    ap.add_argument("--since", type=float, default=None,
+                    help="only render rows measured at/after this unix "
+                         "time (default: current round start per "
+                         "PROGRESS.jsonl; pass 0 for all history)")
+    args = ap.parse_args()
+    since = args.since if args.since is not None else round_start_t(REPO)
+    rows = load(args.results, since=since)
+    meas = [r for r in rows if r.get("dpfs_per_sec")]
+    if not meas:
+        print("no measured rows in %s; nothing to render" % args.results)
+        return 0
+
+    doc = ["# Measured TPU performance", "",
+           "Rendered by `scripts/report.py` from `tpu_results.jsonl` "
+           "(single-claim session, `experiments/tpu_all.py`; every "
+           "throughput row passed the exact share-recovery gate before "
+           "timing — `checked: true`).  Reference numbers: published "
+           "V100/P100 tables, BASELINE.md.", ""]
+
+    # headline
+    heads = best_by(rows, lambda r: r["stage"],
+                    lambda r: (r.get("stage") == "headline"
+                               and r.get("checked")
+                               and r.get("dpfs_per_sec")))
+    if heads:
+        h = heads["headline"]
+        ratio = h["dpfs_per_sec"] / V100[("AES128", 65536)]
+        doc += ["## Headline",
+                "",
+                "**%d dpfs/sec** — AES128, entries=65536, entry_size=16,"
+                " batch=512, one v5e chip (config: %s) — **%.2fx the "
+                "V100's 15,392**." % (h["dpfs_per_sec"], fmt_knobs(h),
+                                      ratio), ""]
+
+    tbl, _ = throughput_table(rows)
+    if tbl:
+        doc += ["## Batched throughput (batch=512, entry_size=16)", ""]
+        doc += tbl + [""]
+
+    # large tables run at batch=64 (HBM headroom at 2^22..2^26); the
+    # reference publishes no numbers past 2^20, so these stand alone
+    large = best_by(rows, lambda r: (r["prf"], r["entries"]),
+                    lambda r: (r.get("stage") == "large"
+                               and r.get("checked")
+                               and r.get("dpfs_per_sec")))
+    if large:
+        doc += ["## Large tables (batch=64, entry_size=16)", "",
+                "| Entries | PRF | dpfs/sec |", "|---|---|---|"]
+        for (prf, n) in sorted(large, key=lambda k: (k[1], k[0])):
+            doc.append("| 2^%d (%d) | %s | %d |" % (
+                n.bit_length() - 1, n, prf,
+                large[(prf, n)]["dpfs_per_sec"]))
+        doc.append("")
+
+    # latency rows (test_dpf_latency records)
+    lat = [r for r in rows if r.get("stage") == "latency"
+           and r.get("latency_ms")]
+    if lat:
+        doc += ["## Single-query latency (batch=1, warm)", "",
+                "| Entries | PRF | scheme | ms |", "|---|---|---|---|"]
+        for r in lat:
+            doc.append("| %s | %s | %s | %.2f |" % (
+                r.get("entries", "?"), r.get("prf", "?"),
+                r.get("scheme", "log-N"), r["latency_ms"]))
+        doc.append("")
+
+    # tuning winners per PRF
+    tun = best_by(rows, lambda r: r["prf"],
+                  lambda r: (r.get("stage") == "tuning"
+                             and r.get("checked")
+                             and r.get("dpfs_per_sec")))
+    if tun:
+        doc += ["## Tuning-sweep winners (entries=65536, batch=512)", "",
+                "| PRF | dpfs/sec | config |", "|---|---|---|"]
+        for prf, r in sorted(tun.items()):
+            doc.append("| %s | %d | %s |" % (prf, r["dpfs_per_sec"],
+                                             fmt_knobs(r)))
+        doc.append("")
+
+    zoo = [r for r in rows if r.get("stage") == "zoo"
+           and r.get("prf_calls_per_sec")]
+    if zoo:
+        doc += ["## PRF zoo (calls/sec, 2^20-call batch)", "",
+                "| candidate | calls/sec |", "|---|---|"]
+        for k, v in sorted(zoo[-1]["prf_calls_per_sec"].items(),
+                           key=lambda kv: -kv[1]):
+            doc.append("| %s | %d |" % (k, v))
+        doc.append("")
+
+    mm = [r for r in rows if r.get("stage") == "matmul"]
+    if mm:
+        doc += ["## Contraction microbench", "", "```"]
+        doc += [json.dumps(r) for r in mm] + ["```", ""]
+
+    out_doc = args.out_doc
+    _write_atomic(out_doc, "\n".join(doc))
+    print("wrote %s (%d measured rows)" % (out_doc, len(meas)))
+
+    if not args.no_readme:
+        readme = args.readme
+        with open(readme) as f:
+            text = f.read()
+        begin, end = "<!-- MEASURED:BEGIN -->", "<!-- MEASURED:END -->"
+        if begin in text and end in text:
+            block = [begin, "", "## Measured performance (TPU v5e)", ""]
+            if heads:
+                h = heads["headline"]
+                block += ["Headline: **%d dpfs/sec** (AES128@65536, "
+                          "batch=512, 1 chip) = **%.2fx** the reference's"
+                          " V100 (15,392)." % (
+                              h["dpfs_per_sec"],
+                              h["dpfs_per_sec"] / V100[("AES128", 65536)]),
+                          ""]
+            block += tbl
+            block += ["", "Full tables: `docs/MEASURED.md`.", "", end]
+            pre = text.split(begin)[0]
+            post = text.split(end)[1]
+            _write_atomic(readme, pre + "\n".join(block) + post)
+            print("updated README measured block")
+        else:
+            print("README markers missing; skipped")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,88 @@
+"""Tests for scripts/report.py (measured-results -> judged artifacts).
+
+All paths are tmp — the repo's README.md / docs/MEASURED.md are never
+touched by the test.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "report.py")
+
+ROWS = [
+    {"stage": "headline", "entries": 65536, "prf": "AES128",
+     "batch_size": 512, "dpfs_per_sec": 18500, "checked": True, "t": 1,
+     "knobs": {"radix": 4, "aes_impl": "bitsliced:bp"}},
+    {"stage": "table", "entries": 16384, "prf": "CHACHA20",
+     "batch_size": 512, "dpfs_per_sec": 150000, "checked": True, "t": 2},
+    # unchecked row: must not be rendered into the table
+    {"stage": "tuning", "entries": 16384, "prf": "AES128",
+     "batch_size": 512, "dpfs_per_sec": 999999, "checked": False, "t": 3},
+    {"stage": "latency", "entries": 16384, "prf": "CHACHA20",
+     "scheme": "sqrtn", "latency_ms": 0.5, "t": 4},
+    {"stage": "zoo", "prf_calls_per_sec": {"chacha12": 9000000}, "t": 5},
+    {"stage": "large", "entries": 1 << 22, "prf": "CHACHA20",
+     "batch_size": 64, "dpfs_per_sec": 700, "checked": True, "t": 6},
+    "garbage line",
+]
+
+
+def _run(tmp_path, rows, readme_text=None, since="0"):
+    results = tmp_path / "results.jsonl"
+    with open(results, "w") as f:
+        for r in rows:
+            f.write((json.dumps(r) if isinstance(r, dict) else r) + "\n")
+    out_doc = tmp_path / "MEASURED.md"
+    readme = tmp_path / "README.md"
+    if readme_text is None:
+        readme_text = ("intro\n<!-- MEASURED:BEGIN -->\nplaceholder\n"
+                       "<!-- MEASURED:END -->\nrest\n")
+    readme.write_text(readme_text)
+    cmd = [sys.executable, SCRIPT, "--results", str(results),
+           "--out-doc", str(out_doc), "--readme", str(readme)]
+    if since is not None:
+        cmd += ["--since", since]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=60)
+    return r, out_doc, readme
+
+
+def test_report_renders_measured_tables(tmp_path):
+    r, out_doc, readme = _run(tmp_path, ROWS)
+    assert r.returncode == 0, r.stderr
+    doc = out_doc.read_text()
+    assert "**18500 dpfs/sec**" in doc and "1.20x" in doc
+    assert "150000" in doc and "139590" in doc  # measured + V100 ref
+    assert "999999" not in doc                  # unchecked row excluded
+    assert "sqrtn" in doc and "0.50" in doc
+    assert "chacha12" in doc
+    assert "2^22" in doc and "| CHACHA20 | 700 |" in doc  # large section
+    text = readme.read_text()
+    assert "placeholder" not in text
+    assert "18500" in text and text.startswith("intro\n")
+    assert text.rstrip().endswith("rest")
+
+
+def test_report_noop_without_measured_rows(tmp_path):
+    r, out_doc, readme = _run(tmp_path, [{"stage": "probe"}])
+    assert r.returncode == 0, r.stderr
+    assert not out_doc.exists()
+    assert "placeholder" in readme.read_text()
+
+
+def test_report_keeps_readme_without_markers(tmp_path):
+    r, out_doc, readme = _run(tmp_path, ROWS, readme_text="no markers\n")
+    assert r.returncode == 0, r.stderr
+    assert out_doc.exists()
+    assert readme.read_text() == "no markers\n"
+
+
+def test_report_gates_on_round_boundary(tmp_path):
+    """Rows measured before --since (a previous round) are not rendered
+    — the artifacts must not advertise a stale best."""
+    r, out_doc, readme = _run(tmp_path, ROWS, since="100.0")
+    assert r.returncode == 0, r.stderr
+    assert not out_doc.exists()
+    assert "placeholder" in readme.read_text()
