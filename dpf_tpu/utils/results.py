@@ -1,0 +1,55 @@
+"""Shared readers for the measurement results JSONL.
+
+``experiments/tpu_all.py`` appends one record per measurement point to
+``tpu_results.jsonl`` across rounds and retries; every record carries a
+``sid`` (one per session process) and ``t`` (unix time).  Renderers
+(``scripts/report.py``, ``experiments/scaling_projection.py``) must
+present a SINGLE self-consistent session — mixing rows from different
+sessions (different code versions, different rounds) can advertise a
+stale best that the current code cannot reproduce.  The canonical scope
+is the latest session that completed with data (its ``stage=="session"``
+record has ``done: true``).
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def load_rows(path):
+    """All well-formed dict records from a results JSONL (missing file
+    or garbage lines -> skipped)."""
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(r, dict):
+                    rows.append(r)
+    except OSError:
+        pass
+    return rows
+
+
+def latest_done_sid(rows):
+    """sid of the newest session record with ``done: true``, else None."""
+    sid = None
+    for r in rows:
+        if (r.get("stage") == "session" and r.get("done")
+                and r.get("sid") is not None):
+            sid = r["sid"]
+    return sid
+
+
+def session_rows(rows, sid=None):
+    """Rows belonging to session ``sid`` (default: the latest completed
+    session).  Returns [] when no completed session exists — renderers
+    fail closed rather than mixing sessions."""
+    if sid is None:
+        sid = latest_done_sid(rows)
+    if sid is None:
+        return []
+    return [r for r in rows if r.get("sid") == sid]

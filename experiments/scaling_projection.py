@@ -28,34 +28,31 @@ sharding divides the table, not the batch).
 """
 
 import argparse
-import json
+
 import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def load_results(path):
-    rows = []
-    if os.path.exists(path):
-        with open(path) as f:
-            for line in f:
-                try:
-                    rows.append(json.loads(line))
-                except ValueError:
-                    pass
-    return rows
-
-
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--results", default="tpu_results.jsonl")
     ap.add_argument("--chips", type=int, default=64)
+    ap.add_argument("--sid", default=None,
+                    help="project from this session id (default: the "
+                         "latest completed session; 'all' merges every "
+                         "session — manual use only)")
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
-    rows = [r for r in load_results(args.results)
-            if r.get("dpfs_per_sec") and r.get("entries")]
+    from dpf_tpu.utils.results import load_rows, session_rows
+    all_rows = load_rows(args.results)
+    scoped = (all_rows if args.sid == "all"
+              else session_rows(all_rows, args.sid))
+    rows = [r for r in scoped
+            if r.get("dpfs_per_sec") and r.get("entries")
+            and r.get("checked")]
     if not rows:
         print("no measured throughput rows in %s — run "
               "experiments/tpu_all.py first" % args.results)

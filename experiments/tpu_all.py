@@ -50,12 +50,16 @@ def main():
     stages = [s.strip() for s in args.stages.split(",") if s.strip()]
     deadline = time.time() + args.deadline_s
     out = open(args.out, "a", buffering=1)
+    # one sid per session process: renderers scope to a single session so
+    # retries / older rounds in the append-only file never mix
+    sid = "%d.%d" % (os.getpid(), int(time.time()))
 
     n_ok = [0]  # non-error, non-skip measurement records this session
 
     def emit(stage, rec):
         rec = dict(rec)
         rec["stage"] = stage
+        rec["sid"] = sid
         rec["t"] = round(time.time(), 1)
         # probe doesn't count: a session where only the tiny probe ran
         # but every measurement stage errored must NOT mark done:true

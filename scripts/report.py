@@ -44,47 +44,6 @@ P100 = {
 }
 
 
-def round_start_t(repo):
-    """Current round's start time from PROGRESS.jsonl, or None (same
-    boundary bench.py uses): the rendered artifacts must reflect THIS
-    round's measurements, not the all-time best from the append-only
-    results file (a stale fast row would mask a later regression)."""
-    starts = {}
-    try:
-        with open(os.path.join(repo, "PROGRESS.jsonl")) as f:
-            for line in f:
-                try:
-                    r = json.loads(line)
-                    starts.setdefault(int(r["round"]), float(r["ts"]))
-                except (ValueError, KeyError, TypeError):
-                    continue
-    except OSError:
-        return None
-    return starts[max(starts)] if starts else None
-
-
-def load(path, since=None):
-    rows = []
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    r = json.loads(line)
-                except ValueError:
-                    continue
-                if not isinstance(r, dict):
-                    continue
-                try:
-                    if since is not None and float(r.get("t", 0)) < since:
-                        continue
-                except (TypeError, ValueError):
-                    continue
-                rows.append(r)
-    except OSError:
-        pass
-    return rows
-
-
 def _write_atomic(path, text):
     tmp = path + ".tmp.%d" % os.getpid()
     with open(tmp, "w") as f:
@@ -153,17 +112,25 @@ def main():
                     default=os.path.join(REPO, "docs", "MEASURED.md"))
     ap.add_argument("--readme", default=os.path.join(REPO, "README.md"))
     ap.add_argument("--no-readme", action="store_true")
-    ap.add_argument("--since", type=float, default=None,
-                    help="only render rows measured at/after this unix "
-                         "time (default: current round start per "
-                         "PROGRESS.jsonl; pass 0 for all history)")
+    ap.add_argument("--sid", default=None,
+                    help="render this session id instead of the latest "
+                         "completed session; pass 'all' to merge every "
+                         "session (manual use only)")
     args = ap.parse_args()
-    since = args.since if args.since is not None else round_start_t(REPO)
-    rows = load(args.results, since=since)
-    meas = [r for r in rows if r.get("dpfs_per_sec")]
-    if not meas:
-        print("no measured rows in %s; nothing to render" % args.results)
+    from dpf_tpu.utils.results import load_rows, session_rows
+    all_rows = load_rows(args.results)
+    rows = (all_rows if args.sid == "all"
+            else session_rows(all_rows, args.sid))
+    # any measured data renders (a session may land only latency/zoo
+    # before a wedge); fail closed when no completed session exists
+    have_data = any(r.get("dpfs_per_sec") or r.get("latency_ms")
+                    or r.get("prf_calls_per_sec")
+                    or r.get("stage") == "matmul" for r in rows)
+    if not have_data:
+        print("no completed session with data in %s; nothing to render"
+              % args.results)
         return 0
+    meas = [r for r in rows if r.get("dpfs_per_sec")]
 
     doc = ["# Measured TPU performance", "",
            "Rendered by `scripts/report.py` from `tpu_results.jsonl` "
@@ -207,16 +174,52 @@ def main():
                 large[(prf, n)]["dpfs_per_sec"]))
         doc.append("")
 
-    # latency rows (test_dpf_latency records)
-    lat = [r for r in rows if r.get("stage") == "latency"
-           and r.get("latency_ms")]
+    # latency rows (test_dpf_latency records), deduped per config: the
+    # best (min) of any retried measurement within the session
+    lat = {}
+    for r in rows:
+        try:
+            if r.get("stage") != "latency" or not r.get("latency_ms"):
+                continue
+            k = (r.get("entries"), r.get("prf"), r.get("scheme", "logn"))
+            if k not in lat or r["latency_ms"] < lat[k]["latency_ms"]:
+                lat[k] = r
+        except TypeError:
+            continue
     if lat:
         doc += ["## Single-query latency (batch=1, warm)", "",
                 "| Entries | PRF | scheme | ms |", "|---|---|---|---|"]
-        for r in lat:
+        for k in sorted(lat, key=lambda k: (str(k[0]), str(k[1]),
+                                            str(k[2]))):
+            r = lat[k]
             doc.append("| %s | %s | %s | %.2f |" % (
                 r.get("entries", "?"), r.get("prf", "?"),
-                r.get("scheme", "log-N"), r["latency_ms"]))
+                r.get("scheme", "logn"), r["latency_ms"]))
+        doc.append("")
+
+    # measured vs the roofline's predicted ranges (docs/PERFORMANCE.md,
+    # v5e table at N=65536) — closes the measured-vs-predicted loop the
+    # roofline doc promises
+    PREDICTED = {"CHACHA20": (12000, 49000), "SALSA20": (12000, 49000),
+                 "AES128": (7500, 30000)}
+    at65536 = best_by(rows, lambda r: r["prf"],
+                      lambda r: (r.get("entries") == 65536
+                                 and r.get("checked")
+                                 and r.get("batch_size") == 512
+                                 and r.get("dpfs_per_sec")))
+    if at65536:
+        doc += ["## Measured vs roofline prediction (N=65536)", "",
+                "| PRF | predicted (docs/PERFORMANCE.md) | measured | "
+                "verdict |", "|---|---|---|---|"]
+        for prf, r in sorted(at65536.items()):
+            lo, hi = PREDICTED.get(prf, (None, None))
+            if lo is None:
+                continue
+            v = r["dpfs_per_sec"]
+            verdict = ("above range" if v > hi else
+                       "below range" if v < lo else "in range")
+            doc.append("| %s | %d – %d | %d | %s |"
+                       % (prf, lo, hi, v, verdict))
         doc.append("")
 
     # tuning winners per PRF

@@ -77,8 +77,10 @@ foreign_claimant() {
 i=0
 while [ ! -f "$STOP_FILE" ]; do
   if [ -f "$OUT" ] && grep -q '"done": true' "$OUT"; then
-    echo "keepalive: session complete; rendering report"
+    echo "keepalive: session complete; rendering report + projection"
     python scripts/report.py >> tpu_keepalive.log 2>&1 || true
+    python experiments/scaling_projection.py --out docs/SCALING.md \
+      >> tpu_keepalive.log 2>&1 || true
     break
   fi
   # re-scan EVERY iteration: a claimant that appeared mid-loop (e.g. a

@@ -1,7 +1,9 @@
 """Tests for scripts/report.py (measured-results -> judged artifacts).
 
 All paths are tmp — the repo's README.md / docs/MEASURED.md are never
-touched by the test.
+touched.  Rendering is scoped to the latest COMPLETED session (sid of
+the newest ``stage=="session", done:true`` record): retries and earlier
+rounds in the append-only results file must never leak into the tables.
 """
 
 import json
@@ -13,24 +15,37 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SCRIPT = os.path.join(REPO, "scripts", "report.py")
 
 ROWS = [
+    # stale-but-faster row from an older session: must NOT render
+    {"stage": "headline", "entries": 65536, "prf": "AES128",
+     "batch_size": 512, "dpfs_per_sec": 99999, "checked": True, "t": 0,
+     "sid": "s0"},
+    {"stage": "session", "done": True, "sid": "s0", "t": 0.5},
     {"stage": "headline", "entries": 65536, "prf": "AES128",
      "batch_size": 512, "dpfs_per_sec": 18500, "checked": True, "t": 1,
-     "knobs": {"radix": 4, "aes_impl": "bitsliced:bp"}},
+     "knobs": {"radix": 4, "aes_impl": "bitsliced:bp"}, "sid": "s1"},
     {"stage": "table", "entries": 16384, "prf": "CHACHA20",
-     "batch_size": 512, "dpfs_per_sec": 150000, "checked": True, "t": 2},
+     "batch_size": 512, "dpfs_per_sec": 150000, "checked": True, "t": 2,
+     "sid": "s1"},
     # unchecked row: must not be rendered into the table
     {"stage": "tuning", "entries": 16384, "prf": "AES128",
-     "batch_size": 512, "dpfs_per_sec": 999999, "checked": False, "t": 3},
+     "batch_size": 512, "dpfs_per_sec": 999999, "checked": False, "t": 3,
+     "sid": "s1"},
+    # duplicated latency config: best (min) wins, rendered once
     {"stage": "latency", "entries": 16384, "prf": "CHACHA20",
-     "scheme": "sqrtn", "latency_ms": 0.5, "t": 4},
-    {"stage": "zoo", "prf_calls_per_sec": {"chacha12": 9000000}, "t": 5},
+     "scheme": "sqrtn", "latency_ms": 0.8, "t": 4, "sid": "s1"},
+    {"stage": "latency", "entries": 16384, "prf": "CHACHA20",
+     "scheme": "sqrtn", "latency_ms": 0.5, "t": 5, "sid": "s1"},
+    {"stage": "zoo", "prf_calls_per_sec": {"chacha12": 9000000}, "t": 6,
+     "sid": "s1"},
     {"stage": "large", "entries": 1 << 22, "prf": "CHACHA20",
-     "batch_size": 64, "dpfs_per_sec": 700, "checked": True, "t": 6},
+     "batch_size": 64, "dpfs_per_sec": 700, "checked": True, "t": 7,
+     "sid": "s1"},
+    {"stage": "session", "done": True, "sid": "s1", "t": 8},
     "garbage line",
 ]
 
 
-def _run(tmp_path, rows, readme_text=None, since="0"):
+def _run(tmp_path, rows, readme_text=None, sid=None):
     results = tmp_path / "results.jsonl"
     with open(results, "w") as f:
         for r in rows:
@@ -43,33 +58,57 @@ def _run(tmp_path, rows, readme_text=None, since="0"):
     readme.write_text(readme_text)
     cmd = [sys.executable, SCRIPT, "--results", str(results),
            "--out-doc", str(out_doc), "--readme", str(readme)]
-    if since is not None:
-        cmd += ["--since", since]
+    if sid is not None:
+        cmd += ["--sid", sid]
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=60)
     return r, out_doc, readme
 
 
-def test_report_renders_measured_tables(tmp_path):
+def test_report_renders_latest_completed_session(tmp_path):
     r, out_doc, readme = _run(tmp_path, ROWS)
     assert r.returncode == 0, r.stderr
     doc = out_doc.read_text()
     assert "**18500 dpfs/sec**" in doc and "1.20x" in doc
     assert "150000" in doc and "139590" in doc  # measured + V100 ref
     assert "999999" not in doc                  # unchecked row excluded
-    assert "sqrtn" in doc and "0.50" in doc
+    assert "99999 dpfs" not in doc              # older session excluded
+    assert doc.count("sqrtn") == 1 and "0.50" in doc and "0.80" not in doc
     assert "chacha12" in doc
     assert "2^22" in doc and "| CHACHA20 | 700 |" in doc  # large section
+    # measured-vs-roofline: AES 18500 lies inside the predicted 7.5K-30K
+    assert "| AES128 | 7500 – 30000 | 18500 | in range |" in doc
     text = readme.read_text()
     assert "placeholder" not in text
     assert "18500" in text and text.startswith("intro\n")
     assert text.rstrip().endswith("rest")
 
 
-def test_report_noop_without_measured_rows(tmp_path):
-    r, out_doc, readme = _run(tmp_path, [{"stage": "probe"}])
+def test_report_explicit_sid_selects_session(tmp_path):
+    r, out_doc, _ = _run(tmp_path, ROWS, sid="s0")
+    assert r.returncode == 0, r.stderr
+    doc = out_doc.read_text()
+    assert "99999" in doc and "18500" not in doc
+
+
+def test_report_noop_without_completed_session(tmp_path):
+    # rows exist but no session record has done:true -> fail closed
+    rows = [r for r in ROWS
+            if not (isinstance(r, dict) and r.get("stage") == "session")]
+    r, out_doc, readme = _run(tmp_path, rows)
     assert r.returncode == 0, r.stderr
     assert not out_doc.exists()
     assert "placeholder" in readme.read_text()
+
+
+def test_report_renders_latency_only_session(tmp_path):
+    rows = [
+        {"stage": "latency", "entries": 16384, "prf": "CHACHA20",
+         "scheme": "logn", "latency_ms": 1.5, "t": 1, "sid": "s2"},
+        {"stage": "session", "done": True, "sid": "s2", "t": 2},
+    ]
+    r, out_doc, _ = _run(tmp_path, rows)
+    assert r.returncode == 0, r.stderr
+    assert "1.50" in out_doc.read_text()
 
 
 def test_report_keeps_readme_without_markers(tmp_path):
@@ -77,12 +116,3 @@ def test_report_keeps_readme_without_markers(tmp_path):
     assert r.returncode == 0, r.stderr
     assert out_doc.exists()
     assert readme.read_text() == "no markers\n"
-
-
-def test_report_gates_on_round_boundary(tmp_path):
-    """Rows measured before --since (a previous round) are not rendered
-    — the artifacts must not advertise a stale best."""
-    r, out_doc, readme = _run(tmp_path, ROWS, since="100.0")
-    assert r.returncode == 0, r.stderr
-    assert not out_doc.exists()
-    assert "placeholder" in readme.read_text()
