@@ -108,70 +108,58 @@ def _worker_main(n):
              "elapsed_s": r["elapsed_s"]})
 
 
-def _round_start_t(repo):
-    """Unix time the current build round started (first PROGRESS.jsonl
-    entry of the max round), or None.  Rows measured before it are a
-    previous round's numbers and must not short-circuit this round's
-    bench (a regression would otherwise stay invisible forever)."""
-    path = os.path.join(repo, "PROGRESS.jsonl")
-    starts = {}
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    r = json.loads(line)
-                    starts.setdefault(int(r["round"]), float(r["ts"]))
-                except (ValueError, KeyError, TypeError):
-                    continue
-    except OSError:
-        return None
-    return starts[max(starts)] if starts else None
-
-
 def _cached_headline(n, path=None, since=None):
     """Best correctness-gated headline-config row measured this round by
     the single-claim session (``experiments/tpu_all.py --out
     tpu_results.jsonl``), or None.  Rows must carry ``checked: true``
     (exact share-recovery gate ran before timing) and a timestamp at or
-    after ``since`` (defaults to the current round's start)."""
+    after ``since`` (defaults to the current round's start, FAIL CLOSED
+    when unknowable).  The latest session COMPLETED this round is
+    preferred (the scope the renderers publish); rows from this round's
+    incomplete sessions are the fallback — a wedge after the headline
+    stage must not discard a real gated measurement."""
     repo = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, repo)
+    try:
+        from dpf_tpu.utils.results import (load_rows, round_start_t,
+                                           session_rows)
+    except ImportError:
+        return None  # library not importable -> no cache, measure live
     if path is None:
         path = os.path.join(repo, "tpu_results.jsonl")
     if since is None:
-        since = _round_start_t(repo)
+        since = round_start_t(repo)
         if since is None:
-            # fail CLOSED: with no round boundary known, a stale row
-            # from an earlier round could mask a regression forever —
-            # prefer a live measurement attempt
             return None
+    rows = load_rows(path)
+    sess = session_rows(rows, since=since)
+
+    def this_round(r):
+        try:
+            return float(r.get("t", 0)) >= since
+        except (TypeError, ValueError):
+            return False
+
     best = None
-    try:
-        with open(path) as f:
-            for line in f:
-                try:
-                    r = json.loads(line)
-                    if (r.get("stage") in ("headline", "table", "tuning")
-                            and r.get("entries") == n
-                            and r.get("prf") == "AES128"
-                            and r.get("batch_size") == 512
-                            and r.get("checked")
-                            and float(r.get("dpfs_per_sec") or 0) > 0
-                            and float(r.get("t", 0)) >= since):
-                        # "headline" rows outrank tuning/table rows at
-                        # any speed: the headline stage re-measures the
-                        # tuning winner, so the metric definition ("best
-                        # verified config, re-measured at headline reps")
-                        # stays comparable round over round
-                        key = (r["stage"] == "headline",
-                               float(r["dpfs_per_sec"]))
-                        if best is None or key > (
-                                best["stage"] == "headline",
-                                float(best["dpfs_per_sec"])):
-                            best = r
-                except (ValueError, TypeError, AttributeError):
-                    continue  # non-object line / wrongly-typed field
-    except OSError:
-        return None
+    for r in (sess if sess else [r for r in rows if this_round(r)]):
+        try:
+            if (r.get("stage") in ("headline", "table", "tuning")
+                    and r.get("entries") == n
+                    and r.get("prf") == "AES128"
+                    and r.get("batch_size") == 512
+                    and r.get("checked")
+                    and float(r.get("dpfs_per_sec") or 0) > 0):
+                # "headline" rows outrank tuning/table rows at any
+                # speed: the headline stage re-measures the tuning
+                # winner, so the metric definition ("best verified
+                # config, re-measured at headline reps") stays
+                # comparable round over round
+                key = (r["stage"] == "headline", float(r["dpfs_per_sec"]))
+                if best is None or key > (best["stage"] == "headline",
+                                          float(best["dpfs_per_sec"])):
+                    best = r
+        except (ValueError, TypeError, AttributeError):
+            continue  # wrongly-typed field
     return best
 
 

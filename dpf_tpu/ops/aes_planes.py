@@ -41,7 +41,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.aes_bitsliced import (_RCON_ARR, _RCON_VALS, _SHIFT_ROWS_BYTE,
+from ..core.aes_bitsliced import (_RCON_VALS, _SHIFT_ROWS_BYTE,
                                   _sbox_bits, _transpose32)
 
 TILE_KEYS = 32       # key rows bit-packed per word (fixed by uint32)
@@ -177,18 +177,24 @@ def aes128_multi_planes(key_planes, n_pts: int, sbox: str | None = None,
         for rnd in range(1, 10):
             states, rk = middle(states, rk, _RCON_VALS[rnd])
     else:
-        rcon_arr = jnp.asarray(_RCON_ARR)
-
+        # rcon is carried as a scalar and stepped by xtime in GF(256)
+        # (rcon_{r+1} = xtime(rcon_r)) instead of indexing a u32[10]
+        # constant: a captured constant array is rejected inside Pallas
+        # kernel bodies, and the recurrence is two scalar ops.
         def body(r, carry):
-            sts, c = carry
+            sts, c, rcon = carry
             states = [[sts[j][i] for i in range(8)]
                       for j in range(n_pts)]
             rkl = [c[i] for i in range(8)]
-            states, rkl = middle(states, rkl, rcon_arr[r])
+            states, rkl = middle(states, rkl, rcon)
+            rcon = ((rcon << np.uint32(1))
+                    ^ ((rcon >> np.uint32(7)) * np.uint32(0x11B))
+                    ) & np.uint32(0xFF)
             return (tuple(jnp.stack(st) for st in states),
-                    jnp.stack(rkl))
+                    jnp.stack(rkl), rcon)
 
-        carry = (tuple(jnp.stack(st) for st in states), jnp.stack(rk))
+        carry = (tuple(jnp.stack(st) for st in states), jnp.stack(rk),
+                 jnp.uint32(1))
         carry = jax.lax.fori_loop(0, 9, body, carry)
         states = [[carry[0][j][i] for i in range(8)]
                   for j in range(n_pts)]
@@ -268,14 +274,15 @@ def _level_planes_core(seed_limbs, cw1_at, cw2_at, arity: int,
     return res
 
 
-def _make_aes_level_kernel(arity: int, sbox: str | None):
+def _make_aes_level_kernel(arity: int, sbox: str | None,
+                           unroll: bool = True):
     def kernel(cw1p_ref, cw2p_ref, seeds_ref, *out_refs):
         # seeds_ref [4, 32, TW]; cw*p_ref [1, arity*128] (SMEM);
         # out_refs: arity x [4, 32, TW]
         res = _level_planes_core(
             [seeds_ref[l] for l in range(4)],
             lambda i: cw1p_ref[0, i], lambda i: cw2p_ref[0, i],
-            arity, sbox)
+            arity, sbox, unroll=unroll)
         for b in range(arity):
             for l in range(4):
                 out_refs[b][l] = res[b][l]
@@ -314,11 +321,9 @@ def aes_level_step_ref(seeds, cw1_lvl, cw2_lvl, *, arity: int = 2,
     return jnp.concatenate(tiles, axis=0)[:bsz]
 
 
-@functools.partial(jax.jit, static_argnames=("arity", "sbox", "interpret",
-                                             "tw"))
-def aes_level_step_pallas(seeds, cw1_lvl, cw2_lvl, *, arity: int = 2,
-                          sbox: str | None = None, interpret: bool = False,
-                          tw: int = DEFAULT_TW):
+def _aes_level_step_impl(seeds, cw1_lvl, cw2_lvl, *, arity: int = 2,
+                         sbox: str | None = None, interpret: bool = False,
+                         tw: int = DEFAULT_TW, unroll: bool = True):
     """One AES GGM level via the plane-domain Pallas kernel.
 
     seeds: [B, w, 4] u32; cw*_lvl: [B, arity, 4] u32 (this level's
@@ -326,6 +331,11 @@ def aes_level_step_pallas(seeds, cw1_lvl, cw2_lvl, *, arity: int = 2,
     node-major order (child b of node j at arity*j + b) — the same
     convention as ``expand._level_step_pair`` / ``radix4._level_step_mixed``,
     so the standard permuted tables apply unchanged.
+
+    ``unroll=False`` runs the 9 middle rounds in a ``fori_loop`` — a
+    ~10x smaller traced graph, used by the interpret-mode tests (the
+    unrolled cipher leg is pinned directly by the cipher-vs-reference
+    tests); the production TPU path keeps the unrolled body.
     """
     from jax.experimental import pallas as pl
 
@@ -353,7 +363,7 @@ def aes_level_step_pallas(seeds, cw1_lvl, cw2_lvl, *, arity: int = 2,
         **({"memory_space": smem} if smem is not None else {}))
 
     grid = (bp // TILE_KEYS, wp // tw)
-    kernel = _make_aes_level_kernel(arity, sbox)
+    kernel = _make_aes_level_kernel(arity, sbox, unroll)
     outs = pl.pallas_call(
         kernel,
         grid=grid,
@@ -371,3 +381,20 @@ def aes_level_step_pallas(seeds, cw1_lvl, cw2_lvl, *, arity: int = 2,
     children = jnp.stack([jnp.transpose(o, (1, 2, 0)) for o in outs],
                          axis=2)                      # [B, w, A, 4]
     return children.reshape(bp, arity * wp, 4)[:bsz, :arity * w]
+
+
+_aes_level_step_jit = functools.partial(
+    jax.jit, static_argnames=("arity", "sbox", "interpret", "tw",
+                              "unroll"))(_aes_level_step_impl)
+
+
+def aes_level_step_pallas(seeds, cw1_lvl, cw2_lvl, *, arity: int = 2,
+                          sbox: str | None = None, interpret: bool = False,
+                          tw: int = DEFAULT_TW, unroll: bool = True):
+    """Jit-wrapped plane-AES level kernel; ``interpret=True`` runs
+    EAGERLY — interpret-mode pallas_call under jit makes XLA-CPU compile
+    blow up super-linearly with grid size (see
+    ``pallas_level.chacha_level_step_pallas``)."""
+    fn = _aes_level_step_impl if interpret else _aes_level_step_jit
+    return fn(seeds, cw1_lvl, cw2_lvl, arity=arity, sbox=sbox,
+              interpret=interpret, tw=tw, unroll=unroll)

@@ -139,9 +139,8 @@ def _level_kernel(seeds_ref, cw1_ref, cw2_ref, out0_ref, out1_ref):
             out_ref[i] = res[i]
 
 
-@functools.partial(jax.jit, static_argnames=("interpret", "tb", "tw"))
-def chacha_level_step_pallas(seeds, cw1_lvl, cw2_lvl, interpret=False,
-                             tb: int = 8, tw: int = 512):
+def _chacha_level_step_impl(seeds, cw1_lvl, cw2_lvl, interpret=False,
+                            tb: int = 8, tw: int = 512):
     """One ChaCha GGM level via Pallas, tiled over (batch, width).
 
     seeds: [B, w, 4] u32; cw*_lvl: [B, 2, 4] u32 (this level's codeword
@@ -182,6 +181,27 @@ def chacha_level_step_pallas(seeds, cw1_lvl, cw2_lvl, interpret=False,
     children = jnp.stack([jnp.transpose(out0, (1, 2, 0)),
                           jnp.transpose(out1, (1, 2, 0))], axis=2)
     return children.reshape(bp, 2 * wp, 4)[:bsz, :2 * w]
+
+
+_chacha_level_step_jit = functools.partial(
+    jax.jit, static_argnames=("interpret", "tb", "tw"))(
+        _chacha_level_step_impl)
+
+
+def chacha_level_step_pallas(seeds, cw1_lvl, cw2_lvl, interpret=False,
+                             tb: int = 8, tw: int = 512):
+    """Jit-wrapped level step; ``interpret=True`` runs EAGERLY.
+
+    XLA-CPU compile of an interpret-mode pallas_call grows super-linearly
+    with grid size (a 2x2 grid was observed past 30 GB / 20 min of
+    compile); eager interpret executes the kernel body op-by-op in
+    seconds.  Only the compiled (TPU) path needs the jit.
+    """
+    if interpret:
+        return _chacha_level_step_impl(seeds, cw1_lvl, cw2_lvl,
+                                       interpret=True, tb=tb, tw=tw)
+    return _chacha_level_step_jit(seeds, cw1_lvl, cw2_lvl,
+                                  interpret=False, tb=tb, tw=tw)
 
 
 # ---------------------------------------------------------------------------
@@ -277,12 +297,10 @@ def _subtree_contract_run(frontier, cw1, cw2, table_perm, *, idx, sched,
     return out[:bsz]
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "depth", "f_levels", "interpret", "tb", "prf_method"))
-def subtree_contract_pallas(frontier, cw1, cw2, table_perm, *,
-                            depth: int, f_levels: int,
-                            interpret=False, tb: int | None = None,
-                            prf_method: int = 2):
+def _subtree_contract_pallas_impl(frontier, cw1, cw2, table_perm, *,
+                                  depth: int, f_levels: int,
+                                  interpret=False, tb: int | None = None,
+                                  prf_method: int = 2):
     """Fused phase-2: expand every frontier subtree in VMEM and contract.
 
     frontier:   [B, F, 4] u32 — phase-1 output seeds (subtree f of key b).
@@ -302,12 +320,30 @@ def subtree_contract_pallas(frontier, cw1, cw2, table_perm, *,
         prf_method=prf_method, interpret=interpret, tb=tb)
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "ars", "f_lv", "interpret", "tb", "prf_method"))
-def subtree_contract_pallas_mixed(frontier, cw1, cw2, table_perm, *,
-                                  ars: tuple, f_lv: int,
-                                  interpret=False, tb: int | None = None,
-                                  prf_method: int = 2):
+_subtree_contract_pallas_jit = functools.partial(jax.jit, static_argnames=(
+    "depth", "f_levels", "interpret", "tb", "prf_method"))(
+        _subtree_contract_pallas_impl)
+
+
+def subtree_contract_pallas(frontier, cw1, cw2, table_perm, *,
+                            depth: int, f_levels: int,
+                            interpret=False, tb: int | None = None,
+                            prf_method: int = 2):
+    """Jit-wrapped fused subtree kernel; ``interpret=True`` runs EAGERLY
+    (see ``chacha_level_step_pallas`` — interpret-under-jit compile
+    blows up super-linearly on XLA-CPU)."""
+    fn = (_subtree_contract_pallas_impl if interpret
+          else _subtree_contract_pallas_jit)
+    return fn(frontier, cw1, cw2, table_perm, depth=depth,
+              f_levels=f_levels, interpret=interpret, tb=tb,
+              prf_method=prf_method)
+
+
+def _subtree_contract_pallas_mixed_impl(frontier, cw1, cw2, table_perm, *,
+                                        ars: tuple, f_lv: int,
+                                        interpret=False,
+                                        tb: int | None = None,
+                                        prf_method: int = 2):
     """Mixed-radix (radix-4) variant: phase-2 covers eval levels
     ``ars[f_lv:]`` with the mixed codeword layout (``radix4.cw_offsets``,
     level-major slots).  Same VMEM-resident expand+contract as the binary
@@ -321,6 +357,24 @@ def subtree_contract_pallas_mixed(frontier, cw1, cw2, table_perm, *,
     return _subtree_contract_run(
         frontier, cw1, cw2, table_perm, idx=idx, sched=sched,
         prf_method=prf_method, interpret=interpret, tb=tb)
+
+
+_subtree_contract_pallas_mixed_jit = functools.partial(
+    jax.jit, static_argnames=("ars", "f_lv", "interpret", "tb",
+                              "prf_method"))(
+        _subtree_contract_pallas_mixed_impl)
+
+
+def subtree_contract_pallas_mixed(frontier, cw1, cw2, table_perm, *,
+                                  ars: tuple, f_lv: int,
+                                  interpret=False, tb: int | None = None,
+                                  prf_method: int = 2):
+    """Jit-wrapped mixed-radix subtree kernel; ``interpret=True`` runs
+    EAGERLY (see ``chacha_level_step_pallas``)."""
+    fn = (_subtree_contract_pallas_mixed_impl if interpret
+          else _subtree_contract_pallas_mixed_jit)
+    return fn(frontier, cw1, cw2, table_perm, ars=ars, f_lv=f_lv,
+              interpret=interpret, tb=tb, prf_method=prf_method)
 
 
 def pallas_chunk_leaves(n: int) -> int:

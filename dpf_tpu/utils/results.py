@@ -2,18 +2,20 @@
 
 ``experiments/tpu_all.py`` appends one record per measurement point to
 ``tpu_results.jsonl`` across rounds and retries; every record carries a
-``sid`` (one per session process) and ``t`` (unix time).  Renderers
-(``scripts/report.py``, ``experiments/scaling_projection.py``) must
-present a SINGLE self-consistent session — mixing rows from different
-sessions (different code versions, different rounds) can advertise a
-stale best that the current code cannot reproduce.  The canonical scope
-is the latest session that completed with data (its ``stage=="session"``
-record has ``done: true``).
+``sid`` (one per session process) and ``t`` (unix time).  Consumers
+(``bench.py``, ``scripts/report.py``, ``experiments/
+scaling_projection.py``) must not mix sessions or rounds: a stale fast
+row from an earlier session/round would advertise numbers the current
+code cannot reproduce and mask regressions.  The canonical scope is the
+latest session that completed with data (``stage=="session"`` record
+with ``done: true``) *within the current build round* (round boundary =
+first PROGRESS.jsonl entry of the max round).
 """
 
 from __future__ import annotations
 
 import json
+import os
 
 
 def load_rows(path):
@@ -34,22 +36,52 @@ def load_rows(path):
     return rows
 
 
-def latest_done_sid(rows):
-    """sid of the newest session record with ``done: true``, else None."""
+def round_start_t(repo_dir=None):
+    """Unix time the current build round started (first PROGRESS.jsonl
+    entry of the max round), or None when the boundary is unknowable
+    (no/unparsable PROGRESS.jsonl).  Callers FAIL CLOSED on None."""
+    if repo_dir is None:
+        repo_dir = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+    starts = {}
+    try:
+        with open(os.path.join(repo_dir, "PROGRESS.jsonl")) as f:
+            for line in f:
+                try:
+                    r = json.loads(line)
+                    starts.setdefault(int(r["round"]), float(r["ts"]))
+                except (ValueError, KeyError, TypeError):
+                    continue
+    except OSError:
+        return None
+    return starts[max(starts)] if starts else None
+
+
+def _t(r):
+    try:
+        return float(r.get("t", 0))
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def latest_done_sid(rows, since=None):
+    """sid of the newest completed session (``done: true``) at/after
+    ``since``, else None."""
     sid = None
     for r in rows:
         if (r.get("stage") == "session" and r.get("done")
-                and r.get("sid") is not None):
+                and r.get("sid") is not None
+                and (since is None or _t(r) >= since)):
             sid = r["sid"]
     return sid
 
 
-def session_rows(rows, sid=None):
-    """Rows belonging to session ``sid`` (default: the latest completed
-    session).  Returns [] when no completed session exists — renderers
-    fail closed rather than mixing sessions."""
+def session_rows(rows, sid=None, since=None):
+    """Rows of session ``sid`` (default: latest session completed
+    at/after ``since``).  [] when none exists — consumers fail closed
+    rather than mixing sessions or rounds."""
     if sid is None:
-        sid = latest_done_sid(rows)
+        sid = latest_done_sid(rows, since=since)
     if sid is None:
         return []
     return [r for r in rows if r.get("sid") == sid]

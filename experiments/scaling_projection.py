@@ -46,10 +46,17 @@ def main():
     ap.add_argument("--out", default=None)
     args = ap.parse_args()
 
-    from dpf_tpu.utils.results import load_rows, session_rows
+    from dpf_tpu.utils.results import (load_rows, round_start_t,
+                                       session_rows)
     all_rows = load_rows(args.results)
-    scoped = (all_rows if args.sid == "all"
-              else session_rows(all_rows, args.sid))
+    if args.sid == "all":
+        scoped = all_rows
+    elif args.sid is not None:
+        scoped = session_rows(all_rows, args.sid)
+    else:
+        since = round_start_t()
+        scoped = ([] if since is None
+                  else session_rows(all_rows, since=since))
     rows = [r for r in scoped
             if r.get("dpfs_per_sec") and r.get("entries")
             and r.get("checked")]

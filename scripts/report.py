@@ -114,13 +114,27 @@ def main():
     ap.add_argument("--no-readme", action="store_true")
     ap.add_argument("--sid", default=None,
                     help="render this session id instead of the latest "
-                         "completed session; pass 'all' to merge every "
-                         "session (manual use only)")
+                         "session completed THIS ROUND; pass 'all' to "
+                         "merge every session (manual use only)")
+    ap.add_argument("--round-start", type=float, default=None,
+                    help="override the round boundary (unix time; "
+                         "default from PROGRESS.jsonl, fail-closed)")
     args = ap.parse_args()
-    from dpf_tpu.utils.results import load_rows, session_rows
+    from dpf_tpu.utils.results import (load_rows, round_start_t,
+                                       session_rows)
     all_rows = load_rows(args.results)
-    rows = (all_rows if args.sid == "all"
-            else session_rows(all_rows, args.sid))
+    if args.sid == "all":
+        rows = all_rows
+    elif args.sid is not None:
+        rows = session_rows(all_rows, args.sid)
+    else:
+        # default scope: latest session completed within this round;
+        # unknown round boundary -> fail closed (render nothing) so a
+        # previous round's numbers are never published as current
+        since = (args.round_start if args.round_start is not None
+                 else round_start_t(REPO))
+        rows = [] if since is None else session_rows(all_rows,
+                                                     since=since)
     # any measured data renders (a session may land only latency/zoo
     # before a wedge); fail closed when no completed session exists
     have_data = any(r.get("dpfs_per_sec") or r.get("latency_ms")

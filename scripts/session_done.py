@@ -1,0 +1,26 @@
+#!/usr/bin/env python
+"""Exit 0 iff the results JSONL has a session completed at/after a time.
+
+Used by scripts/tpu_keepalive.sh to decide when to stop: the loop must
+only key off sessions IT produced (completed after the loop started) —
+a done record left over from an earlier round in the append-only file
+must not stop a fresh loop before it ever launches a claimant.
+
+  python scripts/session_done.py <results.jsonl> <after_unix_time>
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dpf_tpu.utils.results import latest_done_sid, load_rows  # noqa: E402
+
+
+def main():
+    path, after = sys.argv[1], float(sys.argv[2])
+    return 0 if latest_done_sid(load_rows(path), since=after) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -74,13 +74,19 @@ foreign_claimant() {
   return 1
 }
 
+START_TS=$(date +%s)
 i=0
 while [ ! -f "$STOP_FILE" ]; do
-  if [ -f "$OUT" ] && grep -q '"done": true' "$OUT"; then
+  # only a session completed AFTER this loop started stops it (a done
+  # record from an earlier round in the append-only file must not);
+  # a crashing checker exits nonzero -> treated as not-done, loop on
+  if [ -f "$OUT" ] && python scripts/session_done.py "$OUT" "$START_TS" \
+      2>> tpu_keepalive.log; then
     echo "keepalive: session complete; rendering report + projection"
-    python scripts/report.py >> tpu_keepalive.log 2>&1 || true
-    python experiments/scaling_projection.py --out docs/SCALING.md \
+    python scripts/report.py --results "$OUT" \
       >> tpu_keepalive.log 2>&1 || true
+    python experiments/scaling_projection.py --results "$OUT" \
+      --out docs/SCALING.md >> tpu_keepalive.log 2>&1 || true
     break
   fi
   # re-scan EVERY iteration: a claimant that appeared mid-loop (e.g. a

@@ -18,3 +18,22 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from dpf_tpu.utils.hermetic import force_cpu_mesh  # noqa: E402
 
 force_cpu_mesh(8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop jit executables after every test module.
+
+    The AES-circuit graphs (bitsliced XLA + plane-domain Pallas) leave
+    multi-GB compiled executables in the jit cache; accumulated across
+    modules the suite's RSS passed 30 GB and a later XLA-CPU compile
+    segfaulted (deterministic, 2026-07-30, docs/STATUS.md).  Re-compiles
+    within a module still share the cache; cross-module reuse is rare
+    and not worth the blowup.
+    """
+    yield
+    import jax
+
+    jax.clear_caches()

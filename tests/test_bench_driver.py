@@ -91,14 +91,49 @@ def test_cached_headline_rejects_previous_round_rows(tmp_path):
 
 
 def test_round_start_t_reads_progress_log():
-    m = _load_bench()
-    t = m._round_start_t(REPO)
+    import sys
+    sys.path.insert(0, REPO)
+    from dpf_tpu.utils.results import round_start_t
+    t = round_start_t(REPO)
     # PROGRESS.jsonl exists in this repo and has multiple rounds; the
     # current round's start must be later than round 1's first entry
     if t is not None:
         with open(os.path.join(REPO, "PROGRESS.jsonl")) as f:
             first = json.loads(f.readline())
         assert t >= first["ts"]
+
+
+def test_cached_headline_prefers_completed_session():
+    """A faster checked row from a WEDGED (never done) session must not
+    outrank the completed session's headline — bench and the rendered
+    docs must agree on the published number."""
+    import tempfile
+    m = _load_bench()
+    rows = [
+        {"stage": "session", "done": True, "sid": "sA", "t": 3},
+        {"stage": "headline", "entries": 65536, "prf": "AES128",
+         "batch_size": 512, "dpfs_per_sec": 17000, "checked": True,
+         "t": 2, "sid": "sA"},
+        {"stage": "tuning", "entries": 65536, "prf": "AES128",
+         "batch_size": 512, "dpfs_per_sec": 26000, "checked": True,
+         "t": 4, "sid": "sB"},  # wedged session: no done record
+    ]
+    with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                     delete=False) as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+        p = f.name
+    try:
+        best = m._cached_headline(65536, p, since=0)
+        assert best["dpfs_per_sec"] == 17000 and best["sid"] == "sA"
+        # with no completed session at all, the wedged session's gated
+        # row IS the headline (partial data > none)
+        with open(p, "w") as f2:
+            f2.write(json.dumps(rows[2]) + "\n")
+        best = m._cached_headline(65536, p, since=0)
+        assert best["dpfs_per_sec"] == 26000
+    finally:
+        os.unlink(p)
 
 
 def test_cached_headline_tolerates_garbage_and_absence(tmp_path):
@@ -143,7 +178,8 @@ def test_main_fails_closed_without_progress_file(tmp_path):
     try:
         time.sleep(0.2)
         r = subprocess.run([sys.executable, str(dst)],
-                           capture_output=True, text=True, timeout=60)
+                           capture_output=True, text=True, timeout=60,
+                           env=_env_with_repo())
         assert r.returncode == 2, (r.stdout, r.stderr)
         rec = json.loads(r.stdout.strip().splitlines()[-1])
         assert rec["value"] == 0
@@ -166,10 +202,18 @@ def _bench_copy(tmp_path, rows=None):
     return str(dst)
 
 
+def _env_with_repo():
+    """The tmpdir bench.py copy still imports the dpf_tpu library from
+    the real repo (as the deployed bench.py does from its own dir)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
 def test_main_reports_cached_row_without_backend(tmp_path):
     script = _bench_copy(tmp_path, rows=[HEAD])
     r = subprocess.run([sys.executable, script], capture_output=True,
-                       text=True, timeout=60)
+                       text=True, timeout=60, env=_env_with_repo())
     assert r.returncode == 0, r.stderr
     rec = json.loads(r.stdout.strip().splitlines()[-1])
     assert rec["value"] == 17000
@@ -185,7 +229,7 @@ def test_main_refuses_second_claimant(tmp_path):
     try:
         time.sleep(0.2)
         r = subprocess.run([sys.executable, script], capture_output=True,
-                           text=True, timeout=60)
+                           text=True, timeout=60, env=_env_with_repo())
         assert r.returncode == 2, (r.stdout, r.stderr)
         rec = json.loads(r.stdout.strip().splitlines()[-1])
         assert rec["value"] == 0

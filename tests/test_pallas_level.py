@@ -1,17 +1,22 @@
 """Pallas expansion kernels vs the portable XLA path.
 
-Interpret mode costs ~30 s per pallas_call on CPU at ANY size (and
-XLA-CPU compile of a wide interpreted kernel grows super-linearly — a
-width-1024 case was observed to eat 40 GB), so every case here is
-deliberately tiny while still covering the interesting structure:
-multiple key tiles, multiple width tiles, multiple frontier subtrees,
-both ciphers, both radices, and the full-config API path.  On TPU the
-same kernels compile for real (experiments/tpu_all.py tuning stage).
+Interpreter engine choice matters enormously on this 1-core CPU host:
+the generic ``interpret=True`` path compiles the interpreted grid with
+XLA-CPU and blows up super-linearly with grid size (a 2x2-grid level
+step was observed past 30 GB / 20 min of compile), while
+``pltpu.force_tpu_interpret_mode()`` — the TPU-semantics interpreter —
+runs the same case in ~2 s AND models the Mosaic memory spaces the real
+kernel will see.  Every test here therefore uses the TPU interpreter;
+cases stay tiny while covering the structure that matters: multiple key
+tiles, multiple width tiles, multiple frontier subtrees, both ciphers,
+both radices.  On TPU the same kernels compile for real
+(experiments/tpu_all.py tuning stage).
 """
 
 import numpy as np
 
 import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
 
 from dpf_tpu.core import expand, keygen
 
@@ -34,10 +39,10 @@ def _level_case(width_levels, n_keys=1, tb=4, tw=2):
     i = depth - 1 - width_levels
     want = expand._level_step(seeds, jnp.asarray(cw1), jnp.asarray(cw2),
                               i, method)
-    got = pallas_level.chacha_level_step_pallas(
-        seeds, jnp.asarray(cw1[:, 2 * i:2 * i + 2, :]),
-        jnp.asarray(cw2[:, 2 * i:2 * i + 2, :]), interpret=True,
-        tb=tb, tw=tw)
+    with pltpu.force_tpu_interpret_mode():
+        got = pallas_level.chacha_level_step_pallas(
+            seeds, jnp.asarray(cw1[:, 2 * i:2 * i + 2, :]),
+            jnp.asarray(cw2[:, 2 * i:2 * i + 2, :]), tb=tb, tw=tw)
     assert (np.asarray(got) == np.asarray(want)).all()
 
 
@@ -68,9 +73,10 @@ def _subtree_case(n, n_keys, chunk, tb=None, method=2):
         seeds = expand._level_step(seeds, jnp.asarray(cw1),
                                    jnp.asarray(cw2), depth - 1 - l, method)
     from dpf_tpu.ops import pallas_level
-    got = pallas_level.subtree_contract_pallas(
-        seeds, jnp.asarray(cw1), jnp.asarray(cw2), tperm, depth=depth,
-        f_levels=f_levels, interpret=True, tb=tb, prf_method=method)
+    with pltpu.force_tpu_interpret_mode():
+        got = pallas_level.subtree_contract_pallas(
+            seeds, jnp.asarray(cw1), jnp.asarray(cw2), tperm, depth=depth,
+            f_levels=f_levels, tb=tb, prf_method=method)
     assert (np.asarray(got) == np.asarray(want)).all()
 
 
@@ -103,8 +109,9 @@ def test_pallas_subtree_mixed_radix4():
     tperm = jnp.asarray(np.ascontiguousarray(table[perm]))
     want = np.asarray(radix4.expand_and_contract_mixed(
         cw1, cw2, last, tperm, n=n, prf_method=method, chunk_leaves=None))
-    got = np.asarray(radix4.expand_and_contract_mixed_pallas(
-        cw1, cw2, last, tperm, n=n, prf_method=method, interpret=True))
+    with pltpu.force_tpu_interpret_mode():
+        got = np.asarray(radix4.expand_and_contract_mixed_pallas(
+            cw1, cw2, last, tperm, n=n, prf_method=method))
     assert (got == want).all()
 
 

@@ -57,7 +57,8 @@ def _run(tmp_path, rows, readme_text=None, sid=None):
                        "<!-- MEASURED:END -->\nrest\n")
     readme.write_text(readme_text)
     cmd = [sys.executable, SCRIPT, "--results", str(results),
-           "--out-doc", str(out_doc), "--readme", str(readme)]
+           "--out-doc", str(out_doc), "--readme", str(readme),
+           "--round-start", "0"]
     if sid is not None:
         cmd += ["--sid", sid]
     r = subprocess.run(cmd, capture_output=True, text=True, timeout=60)
@@ -116,3 +117,25 @@ def test_report_keeps_readme_without_markers(tmp_path):
     assert r.returncode == 0, r.stderr
     assert out_doc.exists()
     assert readme.read_text() == "no markers\n"
+
+
+def test_report_fails_closed_across_round_boundary(tmp_path):
+    """A session completed BEFORE the round boundary must not render —
+    the artifacts would otherwise republish a previous round's numbers
+    as current."""
+    results = tmp_path / "results.jsonl"
+    with open(results, "w") as f:
+        for r in ROWS:
+            f.write((json.dumps(r) if isinstance(r, dict) else r) + "\n")
+    out_doc = tmp_path / "MEASURED.md"
+    readme = tmp_path / "README.md"
+    readme.write_text("x\n<!-- MEASURED:BEGIN -->\nplaceholder\n"
+                      "<!-- MEASURED:END -->\n")
+    r = subprocess.run(
+        [sys.executable, SCRIPT, "--results", str(results),
+         "--out-doc", str(out_doc), "--readme", str(readme),
+         "--round-start", "100"],  # all sessions completed before t=100
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    assert not out_doc.exists()
+    assert "placeholder" in readme.read_text()

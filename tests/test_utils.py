@@ -111,14 +111,21 @@ def test_scaling_projection_tool(tmp_path):
     import subprocess
     import sys
     res = tmp_path / "r.jsonl"
-    res.write_text(json.dumps({"entries": 1 << 26, "prf": "CHACHA20",
-                               "dpfs_per_sec": 123}) + "\n")
+    # rows must form a completed, correctness-gated session (the tool
+    # scopes to the latest done:true sid and filters checked rows)
+    res.write_text(
+        json.dumps({"stage": "large", "entries": 1 << 26,
+                    "prf": "CHACHA20", "dpfs_per_sec": 123,
+                    "checked": True, "sid": "s1", "t": 1}) + "\n"
+        + json.dumps({"stage": "session", "done": True, "sid": "s1",
+                      "t": 2}) + "\n")
     out = tmp_path / "SCALING.md"
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     r = subprocess.run(
         [sys.executable,
          os.path.join(repo, "experiments", "scaling_projection.py"),
-         "--results", str(res), "--chips", "64", "--out", str(out)],
+         "--results", str(res), "--chips", "64", "--out", str(out),
+         "--sid", "s1"],  # explicit session: bypass the round gate
         capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr[-500:]
     text = out.read_text()
