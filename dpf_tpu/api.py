@@ -393,6 +393,8 @@ class DPF(object):
             out = expand.eval_dispatch(
                 cw1, cw2, last, self.table_device, depth=depth,
                 prf_method=self.prf_method, chunk_leaves=chunk,
+                group=(self._config.dispatch_group if self._config
+                       else None),
                 dot_impl=dot_impl, aes_impl=aes_impl,
                 round_unroll=round_unroll,
                 deadline=self.dispatch_deadline)
@@ -444,6 +446,7 @@ class DPF(object):
             out = radix4.eval_dispatch_mixed(
                 cw1, cw2, last, self.table_device, n=n,
                 prf_method=self.prf_method, chunk_leaves=chunk,
+                group=cfg.dispatch_group,
                 dot_impl=dot_impl, aes_impl=aes_impl,
                 round_unroll=round_unroll,
                 deadline=self.dispatch_deadline)

@@ -219,7 +219,9 @@ def eval_dispatch(cw1, cw2, last, table_perm, *, depth: int,
     f = n // c
     assert c * f == n and depth == int(np.log2(n))
     bsz = last.shape[0]
-    g = group or choose_group(f, c)
+    if group is not None and group < 1:
+        raise ValueError("dispatch group must be >= 1 (got %r)" % (group,))
+    g = min(group or choose_group(f, c), f)
     while f % g:  # explicit `group` may not divide f
         g -= 1
     f_levels = int(np.log2(f))

@@ -580,11 +580,15 @@ _STEP_JIT = None  # module-level per-level jit (cached across batches)
 
 def eval_dispatch_mixed(cw1, cw2, last, table_perm, *, n: int,
                         prf_method: int, chunk_leaves: int | None,
+                        group: int | None = None,
                         dot_impl: str = "i32", aes_impl=None,
                         round_unroll=None, deadline=None):
     """Per-level-program mixed-radix evaluation (the relay-safe mode for
     bitsliced AES — compile time linear in level count, which radix-4
-    halves).  Same math as ``expand_and_contract_mixed``."""
+    halves).  Same math as ``expand_and_contract_mixed``.
+
+    group: frontier subtrees expanded per pass (None = auto, live leaf
+    tensor bounded at ~2^18 per key)."""
     import time as _time
 
     import jax
@@ -610,8 +614,11 @@ def eval_dispatch_mixed(cw1, cw2, last, table_perm, *, n: int,
     f_lv, c = _suffix_chunk(ars, chunk_leaves or n)
     f = n // c
     bsz = last.shape[0]
-    g = max(1, min(f, (1 << 18) // c))
-    while f % g:
+    if group is not None and group < 1:
+        raise ValueError("dispatch group must be >= 1 (got %r)" % (group,))
+    from .expand import choose_group
+    g = min(group or choose_group(f, c), f)
+    while f % g:  # explicit `group` may not divide f
         g -= 1
 
     cw1 = jnp.asarray(cw1)

@@ -19,6 +19,9 @@ class EvalConfig:
     aes_impl: str = "auto"  # "auto"|"gather"|"bitsliced"[":bp"|":tower"]
     kernel_impl: str = "xla"  # "xla" | "pallas" (ChaCha/Salsa subtree
     #                  kernel) | "dispatch" (per-level programs; fast compile)
+    dispatch_group: int | None = None  # dispatch mode: frontier subtrees
+    #                 expanded per pass (None = auto; larger = fewer host
+    #                 round-trips, more live leaf memory per pass)
     radix: int = 2  # 2 = reference-wire-compatible binary GGM;
     #                 4 = TPU-native radix-4 (core/radix4.py): 2/3 the PRF
     #                 children, half the levels, 2x AES schedule amortization

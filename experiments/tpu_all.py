@@ -158,6 +158,14 @@ def main():
             tune(65536, 512, dpf_tpu.PRF_SALSA20,
                  round_unroll=unroll, dot_impl=dot)
         tune(65536, 512, dpf_tpu.PRF_SALSA20, kernel_impl="pallas")
+        # dispatch-group A/B: fewer host round-trips (all subtrees in
+        # one pass) vs the auto memory-bounded grouping
+        tune(65536, 512, dpf_tpu.PRF_AES128, aes_impl="bitsliced:bp",
+             round_unroll=False, kernel_impl="dispatch",
+             dispatch_group=1 << 16)
+        tune(65536, 512, dpf_tpu.PRF_AES128, aes_impl="bitsliced:bp",
+             round_unroll=False, kernel_impl="dispatch",
+             dispatch_group=1)
         # radix-4 construction (core/radix4.py): 2/3 the PRF children,
         # half the levels, 2x AES schedule amortization — vs binary above
         tune(65536, 512, dpf_tpu.PRF_AES128,

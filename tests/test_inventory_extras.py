@@ -176,3 +176,38 @@ def test_eval_dispatch_group_sweep():
             cw1, cw2, last, tperm, depth=depth, prf_method=prf_id,
             chunk_leaves=32, group=g))
         assert (got == want).all(), g
+
+
+def test_dispatch_group_config_knob():
+    """EvalConfig.dispatch_group reaches both dispatch engines through
+    the API and cannot change results (oversized values clamp to f)."""
+    from dpf_tpu import DPF
+    from dpf_tpu.utils.config import EvalConfig
+
+    n = 512
+    table = np.random.randint(0, 2 ** 31, (n, 5),
+                              dtype=np.int64).astype(np.int32)
+    for radix in (2, 4):
+        base = DPF(config=EvalConfig(prf_method=DPF.PRF_CHACHA20,
+                                     radix=radix))
+        base.eval_init(table)
+        k1, k2 = base.gen(77, n)
+        want = np.asarray(base.eval_tpu([k1, k2]))
+        for g in (1, 4, 1 << 16):
+            d = DPF(config=EvalConfig(prf_method=DPF.PRF_CHACHA20,
+                                      radix=radix, kernel_impl="dispatch",
+                                      dispatch_group=g))
+            d.eval_init(table)
+            got = np.asarray(d.eval_tpu([k1, k2]))
+            assert (got == want).all(), (radix, g)
+        rec = (want[0].astype(np.int64) - want[1]).astype(np.int32)
+        assert (rec == table[77]).all(), radix
+    # non-positive groups are rejected loudly, never silently zero
+    import pytest
+    bad = DPF(config=EvalConfig(prf_method=DPF.PRF_CHACHA20,
+                                kernel_impl="dispatch",
+                                dispatch_group=-1))
+    bad.eval_init(table)
+    kb, _ = bad.gen(77, n)  # binary key (the loop's k1 is radix-4)
+    with pytest.raises(ValueError, match="dispatch group"):
+        bad.eval_tpu([kb])
