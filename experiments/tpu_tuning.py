@@ -1,6 +1,11 @@
 #!/usr/bin/env python
 """One-shot TPU tuning sweep: measure every knob combination, report best.
 
+NOTE: the canonical relay-safe sweep is the ``tuning`` stage of
+``experiments/tpu_all.py`` (single claim, JSONL persistence, newer knobs
+incl. ``dispatch_group``/``radix``/``kernel_impl=pallas``); this script
+remains as the quick manual one-shot.
+
 Run on real TPU hardware (takes tens of minutes — each combination
 compiles its own program):
 
