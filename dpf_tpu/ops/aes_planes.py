@@ -362,11 +362,15 @@ def _aes_level_step_impl(seeds, cw1_lvl, cw2_lvl, *, arity: int = 2,
         (1, arity * 128), lambda i, j: (i, 0),
         **({"memory_space": smem} if smem is not None else {}))
 
+    from .pallas_level import _compiler_params
+
     grid = (bp // TILE_KEYS, wp // tw)
     kernel = _make_aes_level_kernel(arity, sbox, unroll)
     outs = pl.pallas_call(
         kernel,
         grid=grid,
+        # key tiles and column tiles are fully independent
+        compiler_params=_compiler_params(("parallel", "parallel")),
         in_specs=[
             cw_spec,
             cw_spec,

@@ -43,6 +43,24 @@ from jax import lax
 from ..core.prf import _SIGMA
 
 
+def _compiler_params(dimension_semantics):
+    """Mosaic grid-dimension semantics ("parallel" dims may be pipelined
+    /parallelized; "arbitrary" = sequential, for accumulation dims).
+    Returns None when the running jax has no CompilerParams (interpret
+    engines ignore it anyway)."""
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:
+        return None
+    for name in ("CompilerParams", "TPUCompilerParams"):  # new/old spelling
+        try:
+            return getattr(pltpu, name)(
+                dimension_semantics=dimension_semantics)
+        except (AttributeError, TypeError):
+            continue
+    return None
+
+
 def _rotl(x, b):
     return (x << np.uint32(b)) | (x >> np.uint32(32 - b))
 
@@ -172,6 +190,7 @@ def _chacha_level_step_impl(seeds, cw1_lvl, cw2_lvl, interpret=False,
     out0, out1 = pl.pallas_call(
         _level_kernel,
         grid=grid,
+        compiler_params=_compiler_params(("parallel", "parallel")),
         in_specs=[spec_seeds, spec_cw, spec_cw],
         out_specs=[spec_out, spec_out],
         out_shape=out_shape,
@@ -293,6 +312,9 @@ def _subtree_contract_run(frontier, cw1, cw2, table_perm, *, idx, sched,
         out_specs=pl.BlockSpec((tb, e), lambda i, f: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((bp, e), jnp.int32),
         interpret=interpret,
+        # key tiles are independent; the subtree axis accumulates into
+        # the same [tb, E] output block (reduction dim -> "arbitrary")
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
     )(seeds, cw1_sl, cw2_sl, table_t)
     return out[:bsz]
 
