@@ -262,12 +262,20 @@ class PrivateLookupServer:
     loops bins on the host instead.
     """
 
-    def __init__(self, table: np.ndarray, bins, prf=None, radix: int = 2):
+    def __init__(self, table: np.ndarray, bins, prf=None, radix: int = 2,
+                 mesh=None):
+        """mesh: optional ``jax.sharding.Mesh`` — equal-size bin groups
+        are embarrassingly parallel, so the stacked [G, n, E] tables and
+        the per-bin key batch shard over ALL mesh axes flattened onto
+        the group axis (G padded with zero bins to the device count);
+        one query round then runs as one SPMD dispatch across the mesh.
+        The reference has no multi-device batch-PIR at all."""
         from ..api import DPF
         from ..core import expand, radix4
         self.prf_method = DPF.DEFAULT_PRF if prf is None else prf
         assert radix in (2, 4)
         self.radix = radix
+        self.mesh = mesh
         self.entry_size = table.shape[1]
         self.bins = [sorted(b) for b in bins]
         self.bin_sizes = []
@@ -287,16 +295,51 @@ class PrivateLookupServer:
                 return np.ascontiguousarray(padded[perm])
             return expand.permute_table(padded)
 
-        # group bins by padded size -> one stacked [G, n, E] device array each
+        # group bins by padded size -> one stacked [G, n, E] device array
+        # each; with a mesh, G pads to the device count and shards
         import jax.numpy as jnp
-        self._groups = {}  # n -> (bin indices, stacked permuted tables)
+        self._groups = {}  # n -> (bin indices, stacked tables, group pad)
         for bi, (n, padded) in enumerate(zip(self.bin_sizes, padded_tables)):
             self._groups.setdefault(n, [[], []])
             self._groups[n][0].append(bi)
             self._groups[n][1].append(permute(padded))
-        self._groups = {
-            n: (idxs, jnp.asarray(np.stack(tbls)))
-            for n, (idxs, tbls) in self._groups.items()}
+        out = {}
+        for n, (idxs, tbls) in self._groups.items():
+            stacked = np.stack(tbls)
+            pad = 0
+            if mesh is not None:
+                pad = (-stacked.shape[0]) % mesh.size
+                if pad:
+                    stacked = np.concatenate(
+                        [stacked, np.zeros((pad,) + stacked.shape[1:],
+                                           np.int32)])
+                stacked = self._shard(jnp.asarray(stacked))
+            else:
+                stacked = jnp.asarray(stacked)
+            out[n] = (idxs, stacked, pad)
+        self._groups = out
+
+    def _shard(self, arr):
+        """Shard axis 0 (the bin-group axis) over every mesh axis."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        spec = P(tuple(self.mesh.axis_names),
+                 *([None] * (arr.ndim - 1)))
+        return jax.device_put(arr, NamedSharding(self.mesh, spec))
+
+    def _pad_keys(self, packed, pad):
+        """Pad the packed key batch (axis 0) to the sharded group size by
+        repeating the last key (answers land in zero-table rows that the
+        caller slices away) and co-shard with the tables."""
+        import jax.numpy as jnp
+        out = []
+        for a in packed:
+            a = np.asarray(a)
+            if pad:
+                a = np.concatenate([a, np.repeat(a[-1:], pad, axis=0)])
+            out.append(self._shard(jnp.asarray(a))
+                       if self.mesh is not None else jnp.asarray(a))
+        return out
 
     def answer(self, keys_per_bin):
         """keys_per_bin: one serialized key per bin -> [n_bins, E] shares."""
@@ -304,7 +347,7 @@ class PrivateLookupServer:
         from ..core import prf as _prf
         from ..ops import matmul128
         out = np.zeros((len(self.bins), self.entry_size), np.int32)
-        for n, (idxs, tables) in self._groups.items():
+        for n, (idxs, tables, gpad) in self._groups.items():
             if self.radix == 4:
                 mk = [radix4.deserialize_mixed_key(keys_per_bin[bi])
                       for bi in idxs]
@@ -312,7 +355,8 @@ class PrivateLookupServer:
                     if k.n != n:
                         raise ValueError(
                             "key for bin of size %d got n=%d" % (n, k.n))
-                cw1, cw2, last = radix4.pack_mixed_keys(mk)
+                cw1, cw2, last = self._pad_keys(
+                    radix4.pack_mixed_keys(mk), gpad)
                 shares = radix4.expand_and_contract_per_key_tables_mixed(
                     cw1, cw2, last, tables, n=n,
                     prf_method=self.prf_method,
@@ -320,14 +364,14 @@ class PrivateLookupServer:
                     dot_impl=matmul128.default_impl(),
                     aes_impl=_prf._aes_pair_impl(),
                     round_unroll=_prf.ROUND_UNROLL)
-                out[idxs] = np.asarray(shares)
+                out[idxs] = np.asarray(shares)[:len(idxs)]
                 continue
             flat = [keygen.deserialize_key(keys_per_bin[bi]) for bi in idxs]
             for fk in flat:
                 if fk.n != n:
                     raise ValueError(
                         "key for bin of size %d got n=%d" % (n, fk.n))
-            cw1, cw2, last = expand.pack_keys(flat)
+            cw1, cw2, last = self._pad_keys(expand.pack_keys(flat), gpad)
             depth = n.bit_length() - 1
             shares = expand.expand_and_contract_per_key_tables(
                 cw1, cw2, last, tables, depth=depth,
@@ -336,7 +380,7 @@ class PrivateLookupServer:
                 dot_impl=matmul128.default_impl(),
                 aes_impl=_prf._aes_pair_impl(),
                 round_unroll=_prf.ROUND_UNROLL)
-            out[idxs] = np.asarray(shares)
+            out[idxs] = np.asarray(shares)[:len(idxs)]
         return out
 
 
