@@ -159,7 +159,22 @@ def _dummy_step(seeds, cw1_lvl, cw2_lvl, *, arity=2, **kw):
                              arity)
 
 
-def test_pallas_aes_driver_glue_binary(monkeypatch):
+@pytest.fixture
+def fresh_driver_caches():
+    """The pallas-AES drivers hold module-level jit caches; a program
+    traced with a monkeypatched level step must never be reused by any
+    test with a different step (same shapes + statics -> same cache key,
+    silently wrong results).  Cleared on BOTH sides: entry protects this
+    test from earlier pollution, teardown removes this test's own
+    patched traces the moment the monkeypatch is undone."""
+    import jax
+
+    jax.clear_caches()
+    yield
+    jax.clear_caches()
+
+
+def test_pallas_aes_driver_glue_binary(monkeypatch, fresh_driver_caches):
     """The binary pallas-AES driver glue vs the standard path (DUMMY
     cipher mock; the real-cipher integration lives behind DPF_RUN_SLOW,
     its math already pinned by the cipher/kernel/ref tests above)."""
@@ -183,7 +198,7 @@ def test_pallas_aes_driver_glue_binary(monkeypatch):
     assert (got == want).all()
 
 
-def test_pallas_aes_driver_glue_radix4(monkeypatch):
+def test_pallas_aes_driver_glue_radix4(monkeypatch, fresh_driver_caches):
     import dpf_tpu
     from dpf_tpu.utils.config import EvalConfig
 
@@ -215,7 +230,7 @@ SLOW = pytest.mark.skipif(
 
 
 @SLOW
-def test_pallas_aes_full_path_binary(monkeypatch):
+def test_pallas_aes_full_path_binary(monkeypatch, fresh_driver_caches):
     """kernel_impl='pallas' + AES through the DPF API vs the XLA path."""
     import dpf_tpu
     from dpf_tpu.utils.config import EvalConfig
@@ -237,7 +252,7 @@ def test_pallas_aes_full_path_binary(monkeypatch):
 
 
 @SLOW
-def test_pallas_aes_full_path_radix4(monkeypatch):
+def test_pallas_aes_full_path_radix4(monkeypatch, fresh_driver_caches):
     import dpf_tpu
     from dpf_tpu.utils.config import EvalConfig
 
