@@ -139,3 +139,23 @@ def test_report_fails_closed_across_round_boundary(tmp_path):
     assert r.returncode == 0, r.stderr
     assert not out_doc.exists()
     assert "placeholder" in readme.read_text()
+
+
+def test_session_done_checker(tmp_path):
+    """scripts/session_done.py: exit 0 only for a session completed
+    at/after the given time (the keepalive's stop condition)."""
+    script = os.path.join(REPO, "scripts", "session_done.py")
+    res = tmp_path / "r.jsonl"
+    res.write_text(json.dumps(
+        {"stage": "session", "done": True, "sid": "s1", "t": 100}) + "\n")
+
+    def run(after):
+        return subprocess.run(
+            [sys.executable, script, str(res), str(after)],
+            capture_output=True, text=True, timeout=60).returncode
+
+    assert run(50) == 0      # completed after -> stop
+    assert run(100) == 0     # boundary inclusive
+    assert run(101) == 1     # stale done record -> keep looping
+    res.write_text("garbage\n")
+    assert run(0) == 1       # no session at all -> keep looping
