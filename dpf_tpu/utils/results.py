@@ -79,9 +79,16 @@ def latest_done_sid(rows, since=None):
 def session_rows(rows, sid=None, since=None):
     """Rows of session ``sid`` (default: latest session completed
     at/after ``since``).  [] when none exists — consumers fail closed
-    rather than mixing sessions or rounds."""
+    rather than mixing sessions or rounds.
+
+    When ``since`` is given, rows timestamped before it are dropped even
+    if they belong to the selected session: a session straddling the
+    round boundary (started late in round N, completed in round N+1)
+    must not leak pre-round measurements into "measured this round"
+    consumers (bench.py's cache, report.py renderers)."""
     if sid is None:
         sid = latest_done_sid(rows, since=since)
     if sid is None:
         return []
-    return [r for r in rows if r.get("sid") == sid]
+    return [r for r in rows if r.get("sid") == sid
+            and (since is None or _t(r) >= since)]

@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Hold a non-blocking exclusive flock on LOCKFILE and exec CMD with the
+lock held for CMD's whole lifetime.
+
+Usage: python flock_exec.py LOCKFILE CMD [ARG...]
+
+Used by ``scripts/tpu_keepalive.sh`` when the flock(1) binary is absent:
+both the keepalive loop and ``bench.py::_claim_lock`` must arbitrate on
+the SAME mechanism (fcntl flock of LOCKFILE itself) or they stop
+mutually excluding (advisor finding, round 4).  flock locks belong to
+the open file description, so they survive exec and are inherited by
+the re-exec'd script; the lock releases exactly when the last holder of
+the fd exits.
+
+Exit status: 1 when another claimant holds the lock (refuse, don't
+wait); otherwise never returns (execvp replaces this process).
+"""
+
+import fcntl
+import os
+import sys
+
+
+def main():
+    if len(sys.argv) < 3:
+        sys.stderr.write("usage: flock_exec.py LOCKFILE CMD [ARG...]\n")
+        sys.exit(2)
+    lock_path, cmd = sys.argv[1], sys.argv[2:]
+    fd = os.open(lock_path, os.O_WRONLY | os.O_CREAT, 0o644)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        sys.stderr.write("flock_exec: %s is held by another claimant; "
+                         "refusing\n" % lock_path)
+        sys.exit(1)
+    os.set_inheritable(fd, True)  # keep the lock across the exec below
+    os.environ["KEEPALIVE_LOCK_FD"] = str(fd)
+    os.execvp(cmd[0], cmd)
+
+
+if __name__ == "__main__":
+    main()

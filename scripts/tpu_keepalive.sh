@@ -22,30 +22,16 @@ if command -v flock > /dev/null 2>&1; then
     echo "keepalive: another instance holds $LOCK_FILE; refusing to start" >&2
     exit 1
   fi
-else
-  # mkdir fallback: PID-stamped so a SIGKILL'd holder's stale lock
-  # self-heals.  Stale recovery is race-free: mv is atomic, so of two
-  # concurrent recoverers exactly one renames the stale dir away and
-  # the loser's mkdir decides against whoever re-creates first.  An
-  # empty/unreadable pid file is treated as a LIVE holder (refuse):
-  # fail-safe during the mkdir->echo window.
-  if ! mkdir "$LOCK_FILE.d" 2> /dev/null; then
-    holder=$(cat "$LOCK_FILE.d/pid" 2> /dev/null || echo "")
-    if [ -z "$holder" ] || kill -0 "$holder" 2> /dev/null; then
-      echo "keepalive: pid '${holder:-?}' holds $LOCK_FILE.d; refusing" >&2
-      exit 1
-    fi
-    if mv "$LOCK_FILE.d" "$LOCK_FILE.d.stale.$$" 2> /dev/null; then
-      echo "keepalive: cleared stale lock (holder $holder dead)" >&2
-      rm -rf "$LOCK_FILE.d.stale.$$"
-    fi
-    if ! mkdir "$LOCK_FILE.d" 2> /dev/null; then
-      echo "keepalive: lost stale-lock recovery race; refusing" >&2
-      exit 1
-    fi
-  fi
-  echo $$ > "$LOCK_FILE.d/pid"
-  trap 'rm -rf "$LOCK_FILE.d"' EXIT
+elif [ -z "${KEEPALIVE_LOCK_FD:-}" ]; then
+  # No flock(1) binary: re-exec self under a python fcntl holder so the
+  # mutual exclusion still lives on $LOCK_FILE ITSELF — bench.py's
+  # _claim_lock flocks that file, and the two claimants must arbitrate
+  # on one mechanism (advisor finding, round 4).  flock_exec.py exits 1
+  # if another claimant holds it; otherwise it execs us with the locked
+  # fd inherited (KEEPALIVE_LOCK_FD set) for this process's lifetime.
+  # absolute path: the cd at the top already moved us off $0's base dir
+  exec python scripts/flock_exec.py "$LOCK_FILE" /bin/sh \
+    "$PWD/scripts/tpu_keepalive.sh" "$@"
 fi
 
 # Live-claimant scan: exact argv-token matching via /proc, and the
