@@ -182,8 +182,14 @@ _SHIFT_ROWS_BYTE = np.array(
     [(4 * ((i // 4 + i % 4) % 4)) + i % 4 for i in range(16)])
 
 
-def _shift_rows(bits):
-    return [b[_SHIFT_ROWS_BYTE] for b in bits]
+def _shift_rows(bits, m: int = 1):
+    """Byte permutation; ``m`` fused states tile the 16-byte pattern."""
+    if m == 1:
+        perm = _SHIFT_ROWS_BYTE
+    else:
+        perm = np.concatenate([_SHIFT_ROWS_BYTE + 16 * k
+                               for k in range(m)])
+    return [b[perm] for b in bits]
 
 
 def _xtime_bits(bits):
@@ -197,7 +203,8 @@ def _xtime_bits(bits):
 
 
 def _mix_columns(bits):
-    a4 = [b.reshape(4, 4, -1) for b in bits]          # [col, row, W]
+    """Works on any multiple of 16 bytes (M fused states = 4M columns)."""
+    a4 = [b.reshape(-1, 4, b.shape[-1]) for b in bits]  # [col, row, W]
     if isinstance(bits[0], np.ndarray):
         roll = np.roll
     else:
@@ -210,7 +217,7 @@ def _mix_columns(bits):
     for i in range(8):
         t = (a4[i][:, 0:1] ^ a4[i][:, 1:2] ^ a4[i][:, 2:3]
              ^ a4[i][:, 3:4])
-        out.append((a4[i] ^ t ^ xt[i]).reshape(16, -1))
+        out.append((a4[i] ^ t ^ xt[i]).reshape(bits[i].shape))
     return out
 
 
@@ -224,25 +231,34 @@ def _concat(parts):
     return jnp.concatenate(parts, axis=0)
 
 
-def _round(st0, st1, rk, rcon_word, ones, sbox: str | None = None):
-    """One AES round on both states + schedule step (see `_round_multi`)."""
-    sub, rk = _round_multi([st0, st1], rk, rcon_word, ones, sbox)
-    return sub[0], sub[1], rk
+def _ark(st, rk, m_cnt):
+    """AddRoundKey on a fused state: st planes [16*M, W] ^ rk [16, W],
+    broadcast through a [M, 16, W] view (no per-state op chains, no rk
+    tiling materialization)."""
+    if m_cnt == 1:
+        return [st[i] ^ rk[i] for i in range(8)]
+    out = []
+    for i in range(8):
+        v = st[i].reshape(m_cnt, 16, -1) ^ rk[i]
+        out.append(v.reshape(st[i].shape))
+    return out
 
 
-def _round_multi(states, rk, rcon_word, ones, sbox: str | None = None):
-    """One AES round on M states + schedule step.  `mix` outside for the
-    final round.  Fuses all 16*M + 4 S-box byte positions into one circuit
-    pass (the GGM node's children share one key, so their SubBytes and the
-    schedule's RotWord ride a single circuit evaluation).
-    Returns (subs, new_rk) with subs[m] = SubBytes(states[m]) (pre-ShiftRows).
+def _round_fused(st, rk, m_cnt, rcon_word, ones, sbox: str | None = None):
+    """One AES SubBytes + schedule step on a FUSED state of M instances.
+
+    ``st``: 8 planes [16*M, W] (states back to back on the byte axis);
+    ``rk``: 8 planes [16, W].  All ``16*M + 4`` S-box byte positions (the
+    GGM node's children share one key, so their SubBytes and the
+    schedule's RotWord) ride a single circuit pass, and — unlike the
+    earlier per-state formulation — ShiftRows/MixColumns/AddRoundKey
+    downstream also run once on the fused tensor, cutting the per-round
+    HLO count ~M-fold (compile time of the dispatch-mode per-level
+    programs scales with it).  Returns (sub, new_rk), sub pre-ShiftRows.
     """
-    m_cnt = len(states)
-    fused_in = [_concat([st[i] for st in states] + [rk[i][_ROT_WORD]])
-                for i in range(8)]
+    fused_in = [_concat([st[i], rk[i][_ROT_WORD]]) for i in range(8)]
     fused_out = _sbox_bits(fused_in, ones, sbox)
-    subs = [[f[16 * m:16 * (m + 1)] for f in fused_out]
-            for m in range(m_cnt)]
+    sub = [f[:16 * m_cnt] for f in fused_out]
     t = [f[16 * m_cnt:16 * m_cnt + 4] for f in fused_out]
     # rcon into byte 0 of the rotated word
     t = [_concat([t[i][0:1] ^ (ones * ((rcon_word >> np.uint32(i))
@@ -261,26 +277,17 @@ def _round_multi(states, rk, rcon_word, ones, sbox: str | None = None):
         else:
             import jax.numpy as jnp
             new_rk.append(jnp.concatenate([w0, w1, w2, w3], axis=0))
-    return subs, new_rk
+    return sub, new_rk
 
 
 _RCON_VALS = [None, 1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36]
 _RCON_ARR = np.array(_RCON_VALS[1:], dtype=np.uint32)
 
 
-def _middle_round(st0, st1, rk, rcon_word, ones, sbox: str | None = None):
-    states, rk = _middle_round_multi([st0, st1], rk, rcon_word, ones, sbox)
-    return states[0], states[1], rk
-
-
-def _middle_round_multi(states, rk, rcon_word, ones,
+def _middle_round_fused(st, rk, m_cnt, rcon_word, ones,
                         sbox: str | None = None):
-    subs, rk = _round_multi(states, rk, rcon_word, ones, sbox)
-    out = []
-    for sub in subs:
-        st = _mix_columns(_shift_rows(sub))
-        out.append([st[i] ^ rk[i] for i in range(8)])
-    return out, rk
+    sub, rk = _round_fused(st, rk, m_cnt, rcon_word, ones, sbox)
+    return _ark(_mix_columns(_shift_rows(sub, m_cnt)), rk, m_cnt), rk
 
 
 def aes128_pair_bitsliced(seeds, unroll: bool | None = None,
@@ -334,59 +341,56 @@ def aes128_multi_bitsliced(seeds, n_pts: int, unroll: bool | None = None,
     rk = [xp.stack([planes[8 * byte + i] for byte in range(16)])
           for i in range(8)]                          # 8 x [16, W]
 
-    zero = xp.zeros((16, w), dtype=xp.uint32)
     ones = xp.zeros((w,), dtype=xp.uint32) + np.uint32(0xFFFFFFFF)
 
-    # plaintext b: only byte 0 is nonzero, planes of bit i = [b bit i]
-    states = []
-    for b in range(n_pts):
-        st = []
-        for i in range(8):
-            if (b >> i) & 1:
-                st.append(_concat([ones[None, :], zero[1:]]) ^ rk[i])
-            else:
-                st.append(zero ^ rk[i])
-        states.append(st)
+    # Fused initial state [16*M, W]: instance b's plaintext has only
+    # byte 0 nonzero (value b), so plane i's block b is rk[i] with row 0
+    # xored by (b >> i) & 1 — built directly on the fused tensor.
+    b_bits = np.array([[(b >> i) & 1 for b in range(n_pts)]
+                       for i in range(8)], dtype=np.uint32)
+    st = []
+    for i in range(8):
+        row0 = ones[None, None, :] * xp.asarray(b_bits[i][:, None, None])
+        pt = xp.concatenate(
+            [row0, xp.zeros((n_pts, 15, w), dtype=xp.uint32)], axis=1)
+        st.append((pt ^ rk[i]).reshape(16 * n_pts, w))
 
     if is_np:
         for rnd in range(1, 10):
-            states, rk = _middle_round_multi(
-                states, rk, np.uint32(_RCON_VALS[rnd]), ones, sbox)
+            st, rk = _middle_round_fused(
+                st, rk, n_pts, np.uint32(_RCON_VALS[rnd]), ones, sbox)
     else:
         import jax
         from . import prf as _prf
         rcon_arr = xp.asarray(_RCON_ARR)
 
         def body(r, carry):
-            sts, c = carry
-            states = [[sts[j][i] for i in range(8)] for j in range(n_pts)]
-            rkl = [c[i] for i in range(8)]
-            states, rkl = _middle_round_multi(states, rkl, rcon_arr[r],
-                                              ones, sbox)
-            return (tuple(xp.stack(st) for st in states), xp.stack(rkl))
+            s, c = carry
+            sl, rkl = _middle_round_fused(
+                [s[i] for i in range(8)], [c[i] for i in range(8)],
+                n_pts, rcon_arr[r], ones, sbox)
+            return (xp.stack(sl), xp.stack(rkl))
 
-        carry = (tuple(xp.stack(st) for st in states), xp.stack(rk))
+        carry = (xp.stack(st), xp.stack(rk))
         carry = jax.lax.fori_loop(0, 9, body, carry,
                                   unroll=_prf._round_unroll()
                                   if unroll is None else unroll)
-        states = [[carry[0][j][i] for i in range(8)] for j in range(n_pts)]
+        st = [carry[0][i] for i in range(8)]
         rk = [carry[1][i] for i in range(8)]
 
     # final round: Sub + Shift + ARK (no MixColumns)
-    subs, rk = _round_multi(states, rk, np.uint32(_RCON_VALS[10]), ones,
-                            sbox)
-    outs = []
-    for sub in subs:
-        sh = _shift_rows(sub)
-        outs.append([sh[i] ^ rk[i] for i in range(8)])
+    sub, rk = _round_fused(st, rk, n_pts, np.uint32(_RCON_VALS[10]), ones,
+                           sbox)
+    fin = _ark(_shift_rows(sub, n_pts), rk, n_pts)
 
-    def to_limbs(st):
-        # st bits[i][byte] -> planes p = 8*byte + i -> limbs
+    def to_limbs(b):
+        # instance b planes bits[i][byte] -> planes p = 8*byte + i -> limbs
         limbs = []
         for l in range(4):
-            pl = [st[p % 8][p // 8] for p in range(32 * l, 32 * l + 32)]
+            pl = [fin[p % 8][16 * b + p // 8]
+                  for p in range(32 * l, 32 * l + 32)]
             limbs.append(unpack_planes(pl))
         out = xp.stack(limbs, axis=-1)[:m]
         return out.reshape(orig_shape)
 
-    return tuple(to_limbs(st) for st in outs)
+    return tuple(to_limbs(b) for b in range(n_pts))

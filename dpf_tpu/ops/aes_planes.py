@@ -73,8 +73,14 @@ def _byte_select(x, perm):
     return jnp.concatenate([x[i:i + 1] for i in perm], axis=0)
 
 
-def _shift_rows(bits):
-    return [_byte_select(b, _SHIFT_ROWS_BYTE) for b in bits]
+def _shift_rows(bits, m: int = 1):
+    """Byte permutation; ``m`` fused states tile the 16-byte pattern."""
+    if m == 1:
+        perm = _SHIFT_ROWS_BYTE
+    else:
+        perm = np.concatenate([_SHIFT_ROWS_BYTE + 16 * k
+                               for k in range(m)])
+    return [_byte_select(b, perm) for b in bits]
 
 
 def _xtime_bits(bits):
@@ -88,7 +94,9 @@ def _xtime_bits(bits):
 
 
 def _mix_columns(bits):
-    a4 = [b.reshape(4, 4, -1) for b in bits]          # [col, row, W]
+    """Works on any multiple of 16 bytes (M fused states = 4M columns);
+    major-axis reshapes only (Mosaic-safe)."""
+    a4 = [b.reshape(-1, 4, b.shape[-1]) for b in bits]  # [col, row, W]
     nxt = [jnp.concatenate([a[:, 1:], a[:, :1]], axis=1) for a in a4]
     x = [a4[i] ^ nxt[i] for i in range(8)]
     xt = _xtime_bits(x)
@@ -96,23 +104,33 @@ def _mix_columns(bits):
     for i in range(8):
         t = (a4[i][:, 0:1] ^ a4[i][:, 1:2] ^ a4[i][:, 2:3]
              ^ a4[i][:, 3:4])
-        out.append((a4[i] ^ t ^ xt[i]).reshape(16, -1))
+        out.append((a4[i] ^ t ^ xt[i]).reshape(bits[i].shape))
     return out
 
 
-def _round_multi(states, rk, rcon, ones_row, sbox):
-    """One fused round on M states + schedule step.  ``rcon`` is either a
-    static int (unrolled rounds: the byte-0 flip folds to a constant) or
-    a traced uint32 scalar (fori_loop rounds: flip via a computed mask).
+def _ark_tiled(st, rk, m_cnt):
+    """AddRoundKey on a fused state via leading-axis rk tiling (concat,
+    not broadcast-reshape: leading-axis concat is the Mosaic-safest)."""
+    if m_cnt == 1:
+        return [st[i] ^ rk[i] for i in range(8)]
+    return [st[i] ^ jnp.concatenate([rk[i]] * m_cnt, axis=0)
+            for i in range(8)]
+
+
+def _round_fused(st, rk, m_cnt, rcon, ones_row, sbox):
+    """One fused round on M fused states (planes [16*M, W]) + schedule
+    step.  ``rcon`` is either a static int (unrolled rounds: the byte-0
+    flip folds to a constant) or a traced uint32 scalar (fori_loop
+    rounds: flip via a computed mask).  ShiftRows/MixColumns/ARK
+    downstream also run once on the fused tensor — the per-round op
+    count no longer scales with M.
     """
-    m_cnt = len(states)
     rot = [jnp.concatenate([rk[i][13:14], rk[i][14:15], rk[i][15:16],
                             rk[i][12:13]], axis=0) for i in range(8)]
-    fused_in = [jnp.concatenate([st[i] for st in states] + [rot[i]],
-                                axis=0) for i in range(8)]
+    fused_in = [jnp.concatenate([st[i], rot[i]], axis=0)
+                for i in range(8)]
     fused_out = _sbox_bits(fused_in, ones_row, sbox)
-    subs = [[f[16 * m:16 * (m + 1)] for f in fused_out]
-            for m in range(m_cnt)]
+    sub = [f[:16 * m_cnt] for f in fused_out]
     t = [f[16 * m_cnt:16 * m_cnt + 4] for f in fused_out]
     if isinstance(rcon, (int, np.integer)):
         t = [jnp.concatenate(
@@ -131,7 +149,7 @@ def _round_multi(states, rk, rcon, ones_row, sbox):
         w2 = w1 ^ rk[i][8:12]
         w3 = w2 ^ rk[i][12:16]
         new_rk.append(jnp.concatenate([w0, w1, w2, w3], axis=0))
-    return subs, new_rk
+    return sub, new_rk
 
 
 def aes128_multi_planes(key_planes, n_pts: int, sbox: str | None = None,
@@ -152,60 +170,55 @@ def aes128_multi_planes(key_planes, n_pts: int, sbox: str | None = None,
                           axis=0) for i in range(8)]  # 8 x [16, W]
     ones_row = jnp.full_like(key_planes[0], np.uint32(0xFFFFFFFF))
 
-    # plaintext b: only byte 0 nonzero; fold into the initial ARK
-    states = []
-    for b in range(n_pts):
-        st = []
-        for i in range(8):
+    # plaintext b: only byte 0 nonzero; fold into the initial ARK.
+    # States live FUSED back to back on the byte axis ([16*M, W] planes)
+    # for the whole cipher.
+    st = []
+    for i in range(8):
+        blocks = []
+        for b in range(n_pts):
             if (b >> i) & 1:
-                st.append(jnp.concatenate(
+                blocks.append(jnp.concatenate(
                     [rk[i][0:1] ^ np.uint32(0xFFFFFFFF), rk[i][1:]],
                     axis=0))
             else:
-                st.append(rk[i])
-        states.append(st)
+                blocks.append(rk[i])
+        st.append(blocks[0] if n_pts == 1
+                  else jnp.concatenate(blocks, axis=0))
 
-    def middle(states, rk, rcon):
-        subs, rk = _round_multi(states, rk, rcon, ones_row, sbox)
-        out = []
-        for sub in subs:
-            st = _mix_columns(_shift_rows(sub))
-            out.append([st[i] ^ rk[i] for i in range(8)])
-        return out, rk
+    def middle(st, rk, rcon):
+        sub, rk = _round_fused(st, rk, n_pts, rcon, ones_row, sbox)
+        return _ark_tiled(_mix_columns(_shift_rows(sub, n_pts)), rk,
+                          n_pts), rk
 
     if unroll:
         for rnd in range(1, 10):
-            states, rk = middle(states, rk, _RCON_VALS[rnd])
+            st, rk = middle(st, rk, _RCON_VALS[rnd])
     else:
         # rcon is carried as a scalar and stepped by xtime in GF(256)
         # (rcon_{r+1} = xtime(rcon_r)) instead of indexing a u32[10]
         # constant: a captured constant array is rejected inside Pallas
         # kernel bodies, and the recurrence is two scalar ops.
         def body(r, carry):
-            sts, c, rcon = carry
-            states = [[sts[j][i] for i in range(8)]
-                      for j in range(n_pts)]
-            rkl = [c[i] for i in range(8)]
-            states, rkl = middle(states, rkl, rcon)
+            s, c, rcon = carry
+            sl, rkl = middle([s[i] for i in range(8)],
+                             [c[i] for i in range(8)], rcon)
             rcon = ((rcon << np.uint32(1))
                     ^ ((rcon >> np.uint32(7)) * np.uint32(0x11B))
                     ) & np.uint32(0xFF)
-            return (tuple(jnp.stack(st) for st in states),
-                    jnp.stack(rkl), rcon)
+            return (jnp.stack(sl), jnp.stack(rkl), rcon)
 
-        carry = (tuple(jnp.stack(st) for st in states), jnp.stack(rk),
-                 jnp.uint32(1))
+        carry = (jnp.stack(st), jnp.stack(rk), jnp.uint32(1))
         carry = jax.lax.fori_loop(0, 9, body, carry)
-        states = [[carry[0][j][i] for i in range(8)]
-                  for j in range(n_pts)]
+        st = [carry[0][i] for i in range(8)]
         rk = [carry[1][i] for i in range(8)]
 
-    subs, rk = _round_multi(states, rk, _RCON_VALS[10], ones_row, sbox)
+    sub, rk = _round_fused(st, rk, n_pts, _RCON_VALS[10], ones_row, sbox)
+    fin = _ark_tiled(_shift_rows(sub, n_pts), rk, n_pts)
     outs = []
-    for sub in subs:
-        sh = _shift_rows(sub)
-        st = [sh[i] ^ rk[i] for i in range(8)]
-        outs.append([st[p % 8][p // 8:p // 8 + 1] for p in range(128)])
+    for b in range(n_pts):
+        outs.append([fin[p % 8][16 * b + p // 8:16 * b + p // 8 + 1]
+                     for p in range(128)])
     return outs
 
 

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""Offline shortest-linear-program search for the S-box bottom layer.
+
+The bitsliced AES S-box (``dpf_tpu/core/aes_sbox_bp.py``) ends in a
+GF(2)-linear layer mapping the 18 product signals z0..z17 (+ the all-ones
+constant) to the 8 output bits.  Its size directly scales AES throughput
+(SubBytes is ~90% of the bitsliced round).  The import-time greedy
+shared-pair CSE lands at 35 XORs; this tool runs the slower
+Boyar-Peralta-style heuristic ("A depth-16 circuit for the AES S-box" /
+SLP minimization literature — public domain knowledge):
+
+* maintain the full XOR-distance table dist[v] = min #known-signals
+  XORing to v over all of GF(2)^19 (2^19 entries, vectorized
+  Bellman-Ford relaxation — exact distances, not estimates);
+* greedily add the signal a^b minimizing sum(dist[target]) with the
+  square-sum tie-break, randomized over tied candidates;
+* restart with different seeds, keep the shortest program.
+
+Found programs are embedded in ``aes_sbox_bp._BOTTOM_PROGRAM`` as data
+and re-verified at import against the machine-solved linear system (the
+proof stays in the library; only the SEARCH is offline — rerun this tool
+after any change to the circuit's top/middle sections):
+
+    python scripts/slp_search.py [--iters 100] [--seed 0]
+"""
+
+import argparse
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dpf_tpu.core.aes_sbox_bp import (_CONST, N_Z, _forward_sections,  # noqa: E402
+                                      _solve_gf2, _true_sbox)
+
+N_IN = N_Z + 1
+INF = np.int16(100)
+
+
+def solved_targets():
+    """8 output-bit masks over (z0..z17, const) from the linear solve —
+    identical to the import-time derivation's base_targets."""
+    sbox = _true_sbox()
+    zmat = np.zeros((256, N_IN), dtype=np.uint8)
+    for v in range(256):
+        x = [np.uint8((v >> (7 - i)) & 1) for i in range(8)]
+        zmat[v, :N_Z] = _forward_sections(x)
+        zmat[v, _CONST] = 1
+    tgts = []
+    for bit in range(8):
+        s = np.array([(sbox[v] >> bit) & 1 for v in range(256)],
+                     dtype=np.uint8)
+        sol = _solve_gf2(zmat, s)
+        assert sol is not None, "inconsistent system (sections changed?)"
+        tgts.append(int(sum(1 << j for j in range(N_IN) if sol[j])))
+    return tgts
+
+
+def _relax(dist, bases):
+    """Exact XOR-distances via Bellman-Ford over the full 2^N_IN space."""
+    idx = np.arange(dist.shape[0], dtype=np.int64)
+    while True:
+        nd = dist
+        for b in bases:
+            nd = np.minimum(nd, nd[idx ^ b] + 1)
+        if (nd == dist).all():
+            return dist
+        dist = nd
+
+
+def synth(tgts, rng, max_ops=60):
+    """One randomized run of the BP heuristic.  Returns ops as
+    (mask_a, mask_b) pairs in creation order, or None on blow-up."""
+    masks = [1 << i for i in range(N_IN)]
+    dist = np.full(1 << N_IN, INF, dtype=np.int16)
+    dist[0] = 0
+    dist = _relax(dist, masks)
+    ops = []
+    while any(dist[t] > 1 for t in tgts):
+        cands = []
+        uniq = sorted(set(masks))
+        known = set(masks)
+        for i in range(len(uniq)):
+            for j in range(i + 1, len(uniq)):
+                c = uniq[i] ^ uniq[j]
+                if c == 0 or c in known:
+                    continue
+                s = q = 0
+                for t in tgts:
+                    dt = min(int(dist[t]), int(dist[t ^ c]) + 1)
+                    s += dt
+                    q += dt * dt
+                cands.append(((s, -q), uniq[i], uniq[j], c))
+        best_key = min(c[0] for c in cands)
+        _, a, b, c = rng.choice([c for c in cands if c[0] == best_key])
+        ops.append((a, b))
+        masks.append(c)
+        dist = _relax(dist, masks)
+        if len(ops) > max_ops:
+            return None
+    return ops
+
+
+def to_program(mask_ops, tgts):
+    """(mask_a, mask_b) ops -> ((dest, a, b) signal-id ops, 8 output ids)
+    in the embeddable ``_BOTTOM_PROGRAM`` format."""
+    sig_of = {1 << i: i for i in range(N_IN)}
+    ops = []
+    nxt = N_IN
+    for a_m, b_m in mask_ops:
+        c_m = a_m ^ b_m
+        ops.append((nxt, sig_of[a_m], sig_of[b_m]))
+        sig_of[c_m] = nxt
+        nxt += 1
+    return ops, [sig_of[t] for t in tgts]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    tgts = solved_targets()
+    best = None
+    t0 = time.time()
+    for it in range(args.iters):
+        ops = synth(tgts, random.Random(args.seed + it))
+        if ops is not None and (best is None or len(ops) < len(best)):
+            best = ops
+            print("# iter %d: %d ops (%.0fs)"
+                  % (it, len(ops), time.time() - t0), flush=True)
+    ops, outs = to_program(best, tgts)
+    print("# paste into dpf_tpu/core/aes_sbox_bp.py:")
+    print("_BOTTOM_PROGRAM = (")
+    print("    %r," % (tuple(ops),))
+    print("    %r," % (tuple(outs),))
+    print(")")
+
+
+if __name__ == "__main__":
+    main()
