@@ -10,7 +10,8 @@ and table row-sharding across a device mesh with psum share reduction.
 
 from .api import DPF  # noqa: F401
 from .core.prf_ref import (  # noqa: F401
-    PRF_AES128, PRF_CHACHA20, PRF_DUMMY, PRF_SALSA20)
+    PRF_AES128, PRF_CHACHA20, PRF_CHACHA20_BLK, PRF_DUMMY, PRF_SALSA20,
+    PRF_SALSA20_BLK)
 from .core.sqrtn import (  # noqa: F401 — O(sqrt N) flat construction
     SqrtKey, deserialize_sqrt_key, generate_sqrt_keys)
 

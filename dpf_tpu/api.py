@@ -19,8 +19,9 @@ import os
 import numpy as np
 
 from .core import evalref, expand, keygen
-from .core.prf_ref import (PRF_AES128, PRF_CHACHA20, PRF_DUMMY, PRF_NAMES,
-                           PRF_SALSA20)
+from .core.prf_ref import (PRF_AES128, PRF_CHACHA20, PRF_CHACHA20_BLK,
+                           PRF_DUMMY, PRF_NAMES, PRF_SALSA20,
+                           PRF_SALSA20_BLK)
 
 
 def _to_numpy(x, dtype=None):
@@ -77,6 +78,11 @@ class DPF(object):
     PRF_SALSA20 = PRF_SALSA20
     PRF_CHACHA20 = PRF_CHACHA20
     PRF_AES128 = PRF_AES128
+    # block-PRG ("wide") variants: one 512-bit stream-cipher block feeds
+    # four GGM children (core/prf_ref.py::prf_salsa20_12_blk) — same
+    # protocol, NOT wire-compatible with the reference's per-child PRFs
+    PRF_SALSA20_BLK = PRF_SALSA20_BLK
+    PRF_CHACHA20_BLK = PRF_CHACHA20_BLK
 
     ENTRY_SIZE = 16       # int32 words per entry (reference parity)
     BATCH_SIZE = 512      # max keys per device dispatch (reference parity)

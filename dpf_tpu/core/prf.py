@@ -20,7 +20,8 @@ from __future__ import annotations
 import numpy as np
 
 from . import u128
-from .prf_ref import PRF_AES128, PRF_CHACHA20, PRF_DUMMY, PRF_SALSA20, SBOX
+from .prf_ref import (PRF_AES128, PRF_CHACHA20, PRF_CHACHA20_BLK,
+                      PRF_DUMMY, PRF_SALSA20, PRF_SALSA20_BLK, SBOX)
 
 _SIGMA = (0x65787061, 0x6E642033, 0x322D6279, 0x7465206B)
 
@@ -69,8 +70,8 @@ def _salsa_qr(x, a, b, c, d):
     x[a] = x[a] ^ _rotl(x[d] + x[c], 18)
 
 
-def prf_salsa20_12_v(seeds, pos: int):
-    """12-round Salsa20 core; key = seed words MSW-first in state 1..4."""
+def _salsa20_12_words_v(seeds, ctr):
+    """Full 16-word Salsa20/12 block (elementwise path)."""
     zero = seeds[..., 0] - seeds[..., 0]
     x = [zero] * 16
     x[0] = zero + np.uint32(_SIGMA[0])
@@ -82,8 +83,8 @@ def prf_salsa20_12_v(seeds, pos: int):
     x[2] = seeds[..., 2]
     x[3] = seeds[..., 1]
     x[4] = seeds[..., 0]
-    x[8] = _pos_word(zero, pos, 1)
-    x[9] = _pos_word(zero, pos, 0)
+    x[8] = _pos_word(zero, ctr, 1)
+    x[9] = _pos_word(zero, ctr, 0)
     init = list(x)
     for _ in range(6):
         _salsa_qr(x, 0, 4, 8, 12)
@@ -94,11 +95,13 @@ def prf_salsa20_12_v(seeds, pos: int):
         _salsa_qr(x, 5, 6, 7, 4)
         _salsa_qr(x, 10, 11, 8, 9)
         _salsa_qr(x, 15, 12, 13, 14)
-    o1 = x[1] + init[1]
-    o2 = x[2] + init[2]
-    o3 = x[3] + init[3]
-    o4 = x[4] + init[4]
-    return u128._stack_last([o4, o3, o2, o1])
+    return [x[i] + init[i] for i in range(16)]
+
+
+def prf_salsa20_12_v(seeds, pos: int):
+    """12-round Salsa20 core; key = seed words MSW-first in state 1..4."""
+    out = _salsa20_12_words_v(seeds, pos)
+    return u128._stack_last([out[4], out[3], out[2], out[1]])
 
 
 def _chacha_qr(x, a, b, c, d):
@@ -112,8 +115,8 @@ def _chacha_qr(x, a, b, c, d):
     x[b] = _rotl(x[b] ^ x[c], 7)
 
 
-def prf_chacha20_12_v(seeds, pos: int):
-    """12-round ChaCha core; key = seed words MSW-first in state 4..7."""
+def _chacha20_12_words_v(seeds, ctr):
+    """Full 16-word ChaCha20/12 block (elementwise path)."""
     zero = seeds[..., 0] - seeds[..., 0]
     x = [zero] * 16
     for i in range(4):
@@ -122,8 +125,8 @@ def prf_chacha20_12_v(seeds, pos: int):
     x[5] = seeds[..., 2]
     x[6] = seeds[..., 1]
     x[7] = seeds[..., 0]
-    x[12] = _pos_word(zero, pos, 1)
-    x[13] = _pos_word(zero, pos, 0)
+    x[12] = _pos_word(zero, ctr, 1)
+    x[13] = _pos_word(zero, ctr, 0)
     init = list(x)
     for _ in range(6):
         _chacha_qr(x, 0, 4, 8, 12)
@@ -134,11 +137,59 @@ def prf_chacha20_12_v(seeds, pos: int):
         _chacha_qr(x, 1, 6, 11, 12)
         _chacha_qr(x, 2, 7, 8, 13)
         _chacha_qr(x, 3, 4, 9, 14)
-    o4 = x[4] + init[4]
-    o5 = x[5] + init[5]
-    o6 = x[6] + init[6]
-    o7 = x[7] + init[7]
-    return u128._stack_last([o7, o6, o5, o4])
+    return [x[i] + init[i] for i in range(16)]
+
+
+def prf_chacha20_12_v(seeds, pos: int):
+    """12-round ChaCha core; key = seed words MSW-first in state 4..7."""
+    out = _chacha20_12_words_v(seeds, pos)
+    return u128._stack_last([out[7], out[6], out[5], out[4]])
+
+
+# ---------------------------------------------------------------------------
+# Block-PRG ("wide") variants: child pos = word group pos%4 of the block
+# at counter pos//4 (prf_ref.prf_salsa20_12_blk) — one 512-bit core call
+# serves four GGM children
+# ---------------------------------------------------------------------------
+
+_BLK_WORDS_V = {PRF_SALSA20_BLK: _salsa20_12_words_v,
+                PRF_CHACHA20_BLK: _chacha20_12_words_v}
+
+
+def _blk_group(out, g: int):
+    """128-bit child from block words [g, g+3] (MSW-first packing)."""
+    return u128._stack_last([out[g + 3], out[g + 2], out[g + 1], out[g]])
+
+
+def _prf_blk(words_fn, seeds, pos):
+    """Child select over a block core: static pos slices a word group at
+    trace time; traced pos (sqrt-N grid) selects dynamically.  The ONE
+    place the group-to-limb mapping lives for every non-scalar backend
+    (``words_fn`` is a ``(seeds, ctr) -> 16 words`` closure — elementwise
+    or fori-loop JAX variant)."""
+    if isinstance(pos, (int, np.integer)):
+        return _blk_group(words_fn(seeds, int(pos) >> 2),
+                          4 * (int(pos) & 3))
+    out = words_fn(seeds, pos >> np.uint32(2))
+    sel = pos & np.uint32(3)
+    res = _blk_group(out, 0)
+    if isinstance(seeds, np.ndarray):
+        where = np.where
+    else:
+        import jax.numpy as jnp
+        where = jnp.where
+    for g in (1, 2, 3):
+        res = where((sel == np.uint32(g))[..., None],
+                    _blk_group(out, 4 * g), res)
+    return res
+
+
+def prf_salsa20_12_blk_v(seeds, pos):
+    return _prf_blk(_salsa20_12_words_v, seeds, pos)
+
+
+def prf_chacha20_12_blk_v(seeds, pos):
+    return _prf_blk(_chacha20_12_words_v, seeds, pos)
 
 
 # ---------------------------------------------------------------------------
@@ -290,10 +341,10 @@ def _salsa_state(seeds, pos: int):
     return jnp.stack(x)
 
 
-def prf_salsa20_12_jax(seeds, pos: int, unroll: bool | None = None):
+def _salsa20_12_words_jax(seeds, ctr, unroll: bool | None = None):
     import jax
     import jax.numpy as jnp
-    init = _salsa_state(seeds, pos)
+    init = _salsa_state(seeds, ctr)
 
     def double_round(_, s):
         x = [s[i] for i in range(16)]
@@ -309,7 +360,11 @@ def prf_salsa20_12_jax(seeds, pos: int, unroll: bool | None = None):
     x = jax.lax.fori_loop(0, 6, double_round, init,
                           unroll=_round_unroll() if unroll is None
                           else unroll)
-    out = x + init
+    return x + init
+
+
+def prf_salsa20_12_jax(seeds, pos: int, unroll: bool | None = None):
+    out = _salsa20_12_words_jax(seeds, pos, unroll)
     return u128._stack_last([out[4], out[3], out[2], out[1]])
 
 
@@ -324,10 +379,10 @@ def _chacha_state(seeds, pos: int):
     return jnp.stack(x)
 
 
-def prf_chacha20_12_jax(seeds, pos: int, unroll: bool | None = None):
+def _chacha20_12_words_jax(seeds, ctr, unroll: bool | None = None):
     import jax
     import jax.numpy as jnp
-    init = _chacha_state(seeds, pos)
+    init = _chacha_state(seeds, ctr)
 
     def double_round(_, s):
         x = [s[i] for i in range(16)]
@@ -347,8 +402,26 @@ def prf_chacha20_12_jax(seeds, pos: int, unroll: bool | None = None):
     x = jax.lax.fori_loop(0, 6, double_round, init,
                           unroll=_round_unroll() if unroll is None
                           else unroll)
-    out = x + init
+    return x + init
+
+
+def prf_chacha20_12_jax(seeds, pos: int, unroll: bool | None = None):
+    out = _chacha20_12_words_jax(seeds, pos, unroll)
     return u128._stack_last([out[7], out[6], out[5], out[4]])
+
+
+_BLK_WORDS_JAX = {PRF_SALSA20_BLK: _salsa20_12_words_jax,
+                  PRF_CHACHA20_BLK: _chacha20_12_words_jax}
+
+
+def prf_salsa20_12_blk_jax(seeds, pos, unroll: bool | None = None):
+    return _prf_blk(lambda s, c: _salsa20_12_words_jax(s, c, unroll),
+                    seeds, pos)
+
+
+def prf_chacha20_12_blk_jax(seeds, pos, unroll: bool | None = None):
+    return _prf_blk(lambda s, c: _chacha20_12_words_jax(s, c, unroll),
+                    seeds, pos)
 
 
 _RCON = np.array([0, 1, 2, 4, 8, 16, 32, 64, 128, 0x1B, 0x36],
@@ -433,6 +506,8 @@ PRF_V_NUMPY = {
     PRF_SALSA20: prf_salsa20_12_v,
     PRF_CHACHA20: prf_chacha20_12_v,
     PRF_AES128: prf_aes128_v,
+    PRF_SALSA20_BLK: prf_salsa20_12_blk_v,
+    PRF_CHACHA20_BLK: prf_chacha20_12_blk_v,
 }
 
 PRF_V_JAX = {
@@ -440,6 +515,8 @@ PRF_V_JAX = {
     PRF_SALSA20: prf_salsa20_12_jax,
     PRF_CHACHA20: prf_chacha20_12_jax,
     PRF_AES128: prf_aes128_jax,
+    PRF_SALSA20_BLK: prf_salsa20_12_blk_jax,
+    PRF_CHACHA20_BLK: prf_chacha20_12_blk_jax,
 }
 
 
@@ -535,6 +612,16 @@ def prf_multi(method: int, seeds, arity: int,
     round cover all of them (16*arity + 4 byte positions), amortizing the
     schedule twice as well as the binary step.
     """
+    if method in _BLK_WORDS_V:
+        # One 512-bit core block serves ALL children (<=4): the whole
+        # point of the block-PRG construction — a radix-4 node costs one
+        # core call instead of four (prf_ref.prf_salsa20_12_blk).
+        assert arity <= 4, "block PRG yields 4 children per counter"
+        if isinstance(seeds, np.ndarray):
+            out = _BLK_WORDS_V[method](seeds, 0)
+        else:
+            out = _BLK_WORDS_JAX[method](seeds, 0, unroll)
+        return tuple(_blk_group(out, 4 * b) for b in range(arity))
     if not isinstance(seeds, np.ndarray) and method == PRF_AES128:
         impl = (aes_impl if aes_impl not in (None, "auto")
                 else _aes_pair_impl())

@@ -29,12 +29,18 @@ PRF_DUMMY = 0
 PRF_SALSA20 = 1
 PRF_CHACHA20 = 2
 PRF_AES128 = 3
+# Block-PRG ("wide") variants — ids 4/5 extend the reference's 0..3
+# (dpf_base/dpf.h:221-235); see ``prf_salsa20_12_blk``.
+PRF_SALSA20_BLK = 4
+PRF_CHACHA20_BLK = 5
 
 PRF_NAMES = {
     PRF_DUMMY: "DUMMY",
     PRF_SALSA20: "SALSA20",
     PRF_CHACHA20: "CHACHA20",
     PRF_AES128: "AES128",
+    PRF_SALSA20_BLK: "SALSA20_BLK",
+    PRF_CHACHA20_BLK: "CHACHA20_BLK",
 }
 
 
@@ -59,13 +65,15 @@ def _seed_words_msw_first(seed: int):
             (seed >> 32) & MASK32, seed & MASK32)
 
 
-def prf_salsa20_12(seed: int, pos: int) -> int:
+def _salsa20_12_words(seed: int, ctr: int):
+    """Full 16-word Salsa20/12 block: key in words 1..4 (MSW first),
+    64-bit counter in words 8..9 (high word first)."""
     s = _seed_words_msw_first(seed)
     x = [0] * 16
     x[0], x[5], x[10], x[15] = _SIGMA
     x[1], x[2], x[3], x[4] = s
-    x[8] = (pos >> 32) & MASK32
-    x[9] = pos & MASK32
+    x[8] = (ctr >> 32) & MASK32
+    x[9] = ctr & MASK32
     init = list(x)
 
     def qr(a, b, c, d):
@@ -84,7 +92,11 @@ def prf_salsa20_12(seed: int, pos: int) -> int:
         qr(10, 11, 8, 9)
         qr(15, 12, 13, 14)
 
-    out = [(x[i] + init[i]) & MASK32 for i in range(16)]
+    return [(x[i] + init[i]) & MASK32 for i in range(16)]
+
+
+def prf_salsa20_12(seed: int, pos: int) -> int:
+    out = _salsa20_12_words(seed, pos)
     return (out[1] << 96) | (out[2] << 64) | (out[3] << 32) | out[4]
 
 
@@ -92,13 +104,15 @@ def prf_salsa20_12(seed: int, pos: int) -> int:
 # ChaCha20/12 core
 # ---------------------------------------------------------------------------
 
-def prf_chacha20_12(seed: int, pos: int) -> int:
+def _chacha20_12_words(seed: int, ctr: int):
+    """Full 16-word ChaCha20/12 block: key in words 4..7 (MSW first),
+    64-bit counter in words 12..13 (high word first)."""
     s = _seed_words_msw_first(seed)
     x = [0] * 16
     x[0], x[1], x[2], x[3] = _SIGMA
     x[4], x[5], x[6], x[7] = s
-    x[12] = (pos >> 32) & MASK32
-    x[13] = pos & MASK32
+    x[12] = (ctr >> 32) & MASK32
+    x[13] = ctr & MASK32
     init = list(x)
 
     def qr(a, b, c, d):
@@ -121,8 +135,43 @@ def prf_chacha20_12(seed: int, pos: int) -> int:
         qr(2, 7, 8, 13)
         qr(3, 4, 9, 14)
 
-    out = [(x[i] + init[i]) & MASK32 for i in range(16)]
+    return [(x[i] + init[i]) & MASK32 for i in range(16)]
+
+
+def prf_chacha20_12(seed: int, pos: int) -> int:
+    out = _chacha20_12_words(seed, pos)
     return (out[4] << 96) | (out[5] << 64) | (out[6] << 32) | out[7]
+
+
+# ---------------------------------------------------------------------------
+# Block-PRG ("wide") variants: the full 512-bit core output as 4 children
+# ---------------------------------------------------------------------------
+
+def _blk_child(out, pos: int) -> int:
+    g = 4 * (pos & 3)
+    return ((out[g] << 96) | (out[g + 1] << 64)
+            | (out[g + 2] << 32) | out[g + 3])
+
+
+def prf_salsa20_12_blk(seed: int, pos: int) -> int:
+    """Salsa20/12 as a length-quadrupling counter-mode PRG.
+
+    The classic GGM step above burns one full 512-bit core block per
+    child and keeps 128 bits of it (as the reference's kernels do,
+    ``dpf_gpu/prf/prf.cu:46-96`` — one uint128 out per call).  Here child
+    ``pos`` is the 128-bit word group ``pos % 4`` of the block at counter
+    ``pos // 4``: one core call yields FOUR children, so a radix-4 GGM
+    level costs one core evaluation per node (6x fewer core calls per
+    leaf than the reference's binary scheme).  Standard counter-mode PRG
+    construction; keys are NOT wire-compatible with the reference (new
+    method id, same 524-int32 container)."""
+    return _blk_child(_salsa20_12_words(seed, pos >> 2), pos)
+
+
+def prf_chacha20_12_blk(seed: int, pos: int) -> int:
+    """ChaCha20/12 as a length-quadrupling counter-mode PRG (see
+    ``prf_salsa20_12_blk``)."""
+    return _blk_child(_chacha20_12_words(seed, pos >> 2), pos)
 
 
 # ---------------------------------------------------------------------------
@@ -241,6 +290,8 @@ PRF_FUNCS = {
     PRF_SALSA20: prf_salsa20_12,
     PRF_CHACHA20: prf_chacha20_12,
     PRF_AES128: prf_aes128,
+    PRF_SALSA20_BLK: prf_salsa20_12_blk,
+    PRF_CHACHA20_BLK: prf_chacha20_12_blk,
 }
 
 

@@ -147,6 +147,34 @@ def generate_sqrt_keys(alpha: int, n: int, seed: bytes, prf_method: int,
             SqrtKey(keys=keys2, cw1=cw1, cw2=cw2, **args))
 
 
+def _grid_vals(prf_method: int, seeds_row, r: int, xp):
+    """PRF values over rows 0..r-1 for a seed tensor broadcast along a
+    leading row axis (``seeds_row``: [..., 1, K, 4]-shaped broadcastable
+    maker, called with the row count to use).
+
+    Block-PRG ids (4/5): rows 4c..4c+3 are the four word groups of ONE
+    core block at counter c — evaluate ceil(r/4) blocks and interleave,
+    a 4x core-call saving on the sqrt-N latency path.  Other ids: one
+    core per row (the generic path).
+    """
+    from .prf import _BLK_WORDS_JAX, _BLK_WORDS_V, _blk_group
+    if prf_method not in _BLK_WORDS_V:
+        rows = xp.arange(r, dtype=xp.uint32)[:, None]
+        return prf_v(prf_method, seeds_row(r), rows)
+    nctr = -(-r // 4)
+    ctr = xp.arange(nctr, dtype=xp.uint32)[:, None]
+    seeds = seeds_row(nctr)
+    if isinstance(seeds, np.ndarray):
+        out16 = _BLK_WORDS_V[prf_method](seeds, ctr)
+    else:
+        out16 = _BLK_WORDS_JAX[prf_method](seeds, ctr)
+    groups = xp.stack([_blk_group(out16, 4 * g) for g in range(4)],
+                      axis=-3)                        # [.., C, 4, K, 4]
+    flat = groups.reshape(groups.shape[:-4] + (4 * nctr,)
+                          + groups.shape[-2:])
+    return flat[..., :r, :, :]
+
+
 def eval_grid(key: SqrtKey, prf_method: int, xp=np):
     """Full one-hot share, natural order: [N] int32 (low 32 bits).
 
@@ -155,9 +183,10 @@ def eval_grid(key: SqrtKey, prf_method: int, xp=np):
     """
     k, r = key.n_keys, key.n_codewords
     keys = xp.asarray(key.keys)                       # [K, 4]
-    seeds = xp.broadcast_to(keys[None, :, :], (r, k, 4))
-    rows = xp.arange(r, dtype=xp.uint32)[:, None]     # [R, 1]
-    vals = prf_v(prf_method, seeds, rows)             # [R, K, 4]
+    vals = _grid_vals(
+        prf_method,
+        lambda nr: xp.broadcast_to(keys[None, :, :], (nr, k, 4)),
+        r, xp)                                        # [R, K, 4]
     sel = (keys[None, :, 0] & np.uint32(1))[..., None]
     cw = xp.where(sel.astype(bool), xp.asarray(key.cw2)[:, None, :],
                   xp.asarray(key.cw1)[:, None, :])    # [R, K, 4]
@@ -203,9 +232,10 @@ def _eval_contract_batched_jit(seeds, cw1, cw2, table, *, prf_method,
 
     bsz, k, _ = seeds.shape
     r = cw1.shape[1]
-    grid = jnp.broadcast_to(seeds[:, None, :, :], (bsz, r, k, 4))
-    rows = jnp.arange(r, dtype=jnp.uint32)[:, None]   # [R, 1] -> bcast
-    vals = prf_v(prf_method, grid, rows)              # [B, R, K, 4]
+    vals = _grid_vals(
+        prf_method,
+        lambda nr: jnp.broadcast_to(seeds[:, None, :, :], (bsz, nr, k, 4)),
+        r, jnp)                                       # [B, R, K, 4]
     sel = (seeds[:, None, :, 0] & np.uint32(1)).astype(bool)[..., None]
     cw = jnp.where(sel, cw2[:, :, None, :], cw1[:, :, None, :])
     out = u128.add128(vals, cw)

@@ -42,8 +42,10 @@ def load():
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_LIB) or (
-            os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+    src_dir = os.path.join(_THIS, "src")
+    newest_src = max(os.path.getmtime(os.path.join(src_dir, f))
+                     for f in os.listdir(src_dir))
+    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < newest_src:
         if not _build():
             return None
     try:

@@ -16,7 +16,17 @@ namespace dpftpu {
 
 typedef unsigned __int128 u128;
 
-enum PrfMethod { kDummy = 0, kSalsa20 = 1, kChaCha20 = 2, kAes128 = 3 };
+enum PrfMethod {
+  kDummy = 0,
+  kSalsa20 = 1,
+  kChaCha20 = 2,
+  kAes128 = 3,
+  // Block-PRG ("wide") variants: child pos = 128-bit word group
+  // pos%4 of the 512-bit core block at counter pos/4 — one core call
+  // serves four GGM children (core/prf_ref.py prf_salsa20_12_blk).
+  kSalsa20Blk = 4,
+  kChaCha20Blk = 5,
+};
 
 inline u128 prf_dummy(u128 seed, u128 pos) {
   u128 t = pos + 4242;
@@ -32,9 +42,9 @@ constexpr uint32_t kSigma[4] = {0x65787061u, 0x6e642033u, 0x322d6279u,
 
 }  // namespace detail
 
-// 12-round Salsa20 core; 128-bit key in state words 1..4 (MSW first),
-// stream position in words 8..9 (high word first); output words 1..4.
-inline u128 prf_salsa20_12(u128 seed, u128 pos) {
+// 12-round Salsa20 full block; 128-bit key in state words 1..4 (MSW
+// first), 64-bit counter in words 8..9 (high word first).
+inline void salsa20_12_block(u128 seed, u128 ctr, uint32_t out[16]) {
   using detail::rotl32;
   uint32_t in[16] = {0}, x[16];
   in[0] = detail::kSigma[0];
@@ -45,8 +55,8 @@ inline u128 prf_salsa20_12(u128 seed, u128 pos) {
   in[2] = static_cast<uint32_t>(seed >> 64);
   in[3] = static_cast<uint32_t>(seed >> 32);
   in[4] = static_cast<uint32_t>(seed);
-  in[8] = static_cast<uint32_t>(pos >> 32);
-  in[9] = static_cast<uint32_t>(pos);
+  in[8] = static_cast<uint32_t>(ctr >> 32);
+  in[9] = static_cast<uint32_t>(ctr);
   std::memcpy(x, in, sizeof(x));
 #define DPFTPU_SALSA_QR(a, b, c, d)   \
   x[b] ^= rotl32(x[a] + x[d], 7);     \
@@ -64,15 +74,19 @@ inline u128 prf_salsa20_12(u128 seed, u128 pos) {
     DPFTPU_SALSA_QR(15, 12, 13, 14)
   }
 #undef DPFTPU_SALSA_QR
-  return (static_cast<u128>(x[1] + in[1]) << 96) |
-         (static_cast<u128>(x[2] + in[2]) << 64) |
-         (static_cast<u128>(x[3] + in[3]) << 32) |
-         static_cast<u128>(x[4] + in[4]);
+  for (int i = 0; i < 16; i++) out[i] = x[i] + in[i];
 }
 
-// 12-round ChaCha core; key in words 4..7 (MSW first), position in words
-// 12..13 (high word first); output words 4..7.
-inline u128 prf_chacha20_12(u128 seed, u128 pos) {
+inline u128 prf_salsa20_12(u128 seed, u128 pos) {
+  uint32_t o[16];
+  salsa20_12_block(seed, pos, o);
+  return (static_cast<u128>(o[1]) << 96) | (static_cast<u128>(o[2]) << 64) |
+         (static_cast<u128>(o[3]) << 32) | static_cast<u128>(o[4]);
+}
+
+// 12-round ChaCha full block; key in words 4..7 (MSW first), 64-bit
+// counter in words 12..13 (high word first).
+inline void chacha20_12_block(u128 seed, u128 ctr, uint32_t out[16]) {
   using detail::rotl32;
   uint32_t in[16] = {0}, x[16];
   for (int i = 0; i < 4; i++) in[i] = detail::kSigma[i];
@@ -80,8 +94,8 @@ inline u128 prf_chacha20_12(u128 seed, u128 pos) {
   in[5] = static_cast<uint32_t>(seed >> 64);
   in[6] = static_cast<uint32_t>(seed >> 32);
   in[7] = static_cast<uint32_t>(seed);
-  in[12] = static_cast<uint32_t>(pos >> 32);
-  in[13] = static_cast<uint32_t>(pos);
+  in[12] = static_cast<uint32_t>(ctr >> 32);
+  in[13] = static_cast<uint32_t>(ctr);
   std::memcpy(x, in, sizeof(x));
 #define DPFTPU_CHACHA_QR(a, b, c, d)      \
   x[a] += x[b]; x[d] = rotl32(x[d] ^ x[a], 16); \
@@ -99,10 +113,34 @@ inline u128 prf_chacha20_12(u128 seed, u128 pos) {
     DPFTPU_CHACHA_QR(3, 4, 9, 14)
   }
 #undef DPFTPU_CHACHA_QR
-  return (static_cast<u128>(x[4] + in[4]) << 96) |
-         (static_cast<u128>(x[5] + in[5]) << 64) |
-         (static_cast<u128>(x[6] + in[6]) << 32) |
-         static_cast<u128>(x[7] + in[7]);
+  for (int i = 0; i < 16; i++) out[i] = x[i] + in[i];
+}
+
+inline u128 prf_chacha20_12(u128 seed, u128 pos) {
+  uint32_t o[16];
+  chacha20_12_block(seed, pos, o);
+  return (static_cast<u128>(o[4]) << 96) | (static_cast<u128>(o[5]) << 64) |
+         (static_cast<u128>(o[6]) << 32) | static_cast<u128>(o[7]);
+}
+
+// Block-PRG variants (see PrfMethod): group pos%4 of block at counter pos/4.
+inline u128 blk_child(const uint32_t o[16], u128 pos) {
+  int g = 4 * static_cast<int>(pos & 3);
+  return (static_cast<u128>(o[g]) << 96) |
+         (static_cast<u128>(o[g + 1]) << 64) |
+         (static_cast<u128>(o[g + 2]) << 32) | static_cast<u128>(o[g + 3]);
+}
+
+inline u128 prf_salsa20_12_blk(u128 seed, u128 pos) {
+  uint32_t o[16];
+  salsa20_12_block(seed, pos >> 2, o);
+  return blk_child(o, pos);
+}
+
+inline u128 prf_chacha20_12_blk(u128 seed, u128 pos) {
+  uint32_t o[16];
+  chacha20_12_block(seed, pos >> 2, o);
+  return blk_child(o, pos);
 }
 
 // ---------------------------------------------------------------------------
@@ -235,6 +273,8 @@ inline u128 prf(int method, u128 seed, u128 pos) {
     case kSalsa20: return prf_salsa20_12(seed, pos);
     case kChaCha20: return prf_chacha20_12(seed, pos);
     case kAes128: return prf_aes128(seed, pos);
+    case kSalsa20Blk: return prf_salsa20_12_blk(seed, pos);
+    case kChaCha20Blk: return prf_chacha20_12_blk(seed, pos);
   }
   return 0;
 }

@@ -65,20 +65,27 @@ def _rotl(x, b):
     return (x << np.uint32(b)) | (x >> np.uint32(32 - b))
 
 
-def _chacha_core_planes(s, pos_word):
-    """ChaCha20-12 core on 4 seed planes (any common shape) -> 4 planes.
+def _chacha_block_planes(s, pos_word):
+    """ChaCha20-12 full block on 4 seed planes -> 16 output words.
 
     Key/position placement matches ``core/prf._chacha_state`` (seed limbs
-    LSW-first occupy state words 7..4; output words 7..4 map to limbs
-    LSW-first) so results are bit-identical to the portable path.
+    LSW-first occupy state words 7..4) so results are bit-identical to
+    the portable path.  The 6 double rounds run in a ``lax.fori_loop``:
+    a fully unrolled body, chained across subtree levels through the
+    block's constant-initialized output words, sends the XLA CPU
+    simplifier into a pathological slow compile (hours at depth 6); the
+    loop form compiles in seconds on every backend and Mosaic handles
+    static-trip-count loops natively.
     """
     zero = s[0] - s[0]
     x = [zero + np.uint32(_SIGMA[i]) for i in range(4)]
     x += [s[3], s[2], s[1], s[0]]
     x += [zero] * 4
     x += [zero, zero + np.uint32(pos_word), zero, zero]
-    init = list(x)
-    for _ in range(6):
+    init = jnp.stack(x)
+
+    def double_round(_, st):
+        x = [st[i] for i in range(16)]
         for (a, b, c, d) in ((0, 4, 8, 12), (1, 5, 9, 13),
                              (2, 6, 10, 14), (3, 7, 11, 15),
                              (0, 5, 10, 15), (1, 6, 11, 12),
@@ -91,13 +98,22 @@ def _chacha_core_planes(s, pos_word):
             x[d] = _rotl(x[d] ^ x[a], 8)
             x[c] = x[c] + x[d]
             x[b] = _rotl(x[b] ^ x[c], 7)
-    return [x[7] + init[7], x[6] + init[6], x[5] + init[5], x[4] + init[4]]
+        return jnp.stack(x)
+
+    out = lax.fori_loop(0, 6, double_round, init) + init
+    return [out[i] for i in range(16)]
 
 
-def _salsa_core_planes(s, pos_word):
-    """Salsa20-12 core on 4 seed planes — layout matches
-    ``core/prf._salsa_state`` (key at words 4..1 LSW-last, pos at word 9,
-    output words 4..1 -> limbs LSW-first)."""
+def _chacha_core_planes(s, pos_word):
+    """ChaCha20-12 core -> 4 output planes (words 7..4, limbs LSW-first)."""
+    o = _chacha_block_planes(s, pos_word)
+    return [o[7], o[6], o[5], o[4]]
+
+
+def _salsa_block_planes(s, pos_word):
+    """Salsa20-12 full block — layout matches ``core/prf._salsa_state``
+    (key at words 4..1 LSW-last, pos at word 9).  fori_loop rounds for
+    the same compile-pathology reason as ``_chacha_block_planes``."""
     zero = s[0] - s[0]
     x = [zero] * 16
     x[0] = zero + np.uint32(_SIGMA[0])
@@ -106,8 +122,10 @@ def _salsa_core_planes(s, pos_word):
     x[15] = zero + np.uint32(_SIGMA[3])
     x[1], x[2], x[3], x[4] = s[3], s[2], s[1], s[0]
     x[9] = zero + np.uint32(pos_word)
-    init = list(x)
-    for _ in range(6):
+    init = jnp.stack(x)
+
+    def double_round(_, st):
+        x = [st[i] for i in range(16)]
         for (a, b, c, d) in ((0, 4, 8, 12), (5, 9, 13, 1), (10, 14, 2, 6),
                              (15, 3, 7, 11), (0, 1, 2, 3), (5, 6, 7, 4),
                              (10, 11, 8, 9), (15, 12, 13, 14)):
@@ -115,10 +133,23 @@ def _salsa_core_planes(s, pos_word):
             x[c] = x[c] ^ _rotl(x[b] + x[a], 9)
             x[d] = x[d] ^ _rotl(x[c] + x[b], 13)
             x[a] = x[a] ^ _rotl(x[d] + x[c], 18)
-    return [x[4] + init[4], x[3] + init[3], x[2] + init[2], x[1] + init[1]]
+        return jnp.stack(x)
+
+    out = lax.fori_loop(0, 6, double_round, init) + init
+    return [out[i] for i in range(16)]
+
+
+def _salsa_core_planes(s, pos_word):
+    """Salsa20-12 core -> 4 output planes (words 4..1, limbs LSW-first)."""
+    o = _salsa_block_planes(s, pos_word)
+    return [o[4], o[3], o[2], o[1]]
 
 
 _CORES = {2: _chacha_core_planes, 1: _salsa_core_planes}  # prf id -> core
+# block-PRG ids (core/prf_ref.py): ONE core call per node feeds all
+# children — child b = block words [4b..4b+3] MSW-first, i.e. planes
+# (limbs LSW-first) [4b+3, 4b+2, 4b+1, 4b]
+_BLK_CORES = {4: _salsa_block_planes, 5: _chacha_block_planes}
 
 
 def _add128_planes(val, cw):
@@ -227,11 +258,16 @@ def chacha_level_step_pallas(seeds, cw1_lvl, cw2_lvl, interpret=False,
 # Fused subtree expand + contract (the production kernel)
 # ---------------------------------------------------------------------------
 
-def _make_subtree_kernel(sched: tuple, core=_chacha_core_planes):
+def _make_subtree_kernel(sched: tuple, prf_method: int = 2):
     """Kernel over a per-level arity schedule.  ``sched[k]`` is the
     fan-out of kernel level k; the sliced codeword arrays hold the levels'
-    slots back to back in the same order (see the wrapper's ``idx``)."""
+    slots back to back in the same order (see the wrapper's ``idx``).
+    Block-PRG methods evaluate ONE core per node per level and split the
+    512-bit block into the children (4x fewer cores at arity 4)."""
     from jax.experimental import pallas as pl
+
+    blk = _BLK_CORES.get(prf_method)
+    core = None if blk is not None else _CORES[prf_method]
 
     def kernel(seeds_ref, cw1_ref, cw2_ref, table_ref, out_ref):
         f = pl.program_id(1)
@@ -239,9 +275,15 @@ def _make_subtree_kernel(sched: tuple, core=_chacha_core_planes):
         off = 0
         for a in sched:
             sel = (planes[0] & np.uint32(1)).astype(jnp.bool_)  # [TB, w]
+            if blk is not None:
+                out16 = blk(planes, np.uint32(0))
             children = []
             for b in range(a):
-                val = core(planes, np.uint32(b))
+                if blk is not None:
+                    val = [out16[4 * b + 3], out16[4 * b + 2],
+                           out16[4 * b + 1], out16[4 * b]]
+                else:
+                    val = core(planes, np.uint32(b))
                 cw = [jnp.where(sel, cw2_ref[i, :, off + b][:, None],
                                 cw1_ref[i, :, off + b][:, None])
                       for i in range(4)]
@@ -299,7 +341,7 @@ def _subtree_contract_run(frontier, cw1, cw2, table_perm, *, idx, sched,
     table_t = table_perm.T                            # [E, N]
 
     grid = (bp // tb, f_cnt)
-    kernel = _make_subtree_kernel(tuple(sched), _CORES[prf_method])
+    kernel = _make_subtree_kernel(tuple(sched), prf_method)
     out = pl.pallas_call(
         kernel,
         grid=grid,

@@ -11,7 +11,10 @@ from dataclasses import dataclass, replace
 @dataclass(frozen=True)
 class EvalConfig:
     """Everything that selects a compiled evaluation program."""
-    prf_method: int = 3            # PRF_AES128
+    prf_method: int = 3  # PRF_AES128; 0..3 = reference ids, 4/5 =
+    #                 SALSA20_BLK/CHACHA20_BLK block-PRG variants (one
+    #                 512-bit core block feeds four GGM children —
+    #                 core/prf_ref.py::prf_salsa20_12_blk)
     batch_size: int = 512          # device dispatch cap (reference parity)
     chunk_leaves: int | None = None  # None = auto (choose_chunk)
     dot_impl: str = "i32"          # "i32" | "mxu" (ops/matmul128)

@@ -90,7 +90,9 @@ def main():
 
     PRF_NAMES = {dpf_tpu.PRF_SALSA20: "SALSA20",
                  dpf_tpu.PRF_CHACHA20: "CHACHA20",
-                 dpf_tpu.PRF_AES128: "AES128"}
+                 dpf_tpu.PRF_AES128: "AES128",
+                 dpf_tpu.PRF_SALSA20_BLK: "SALSA20_BLK",
+                 dpf_tpu.PRF_CHACHA20_BLK: "CHACHA20_BLK"}
 
     def cfg_for(prf, batch, **kw):
         # AES always via dispatch mode (monolithic bitsliced compile can
@@ -186,6 +188,15 @@ def main():
         # radix-4 ChaCha on the mixed-arity Pallas subtree kernel
         tune(65536, 512, dpf_tpu.PRF_CHACHA20, kernel_impl="pallas",
              radix=4)
+        # block-PRG ("wide") stream ciphers: ONE 512-bit core block feeds
+        # all children (core/prf_ref.py::prf_*_blk) — radix-4 blk costs
+        # 1/4 the core calls of classic radix-4 and 1/6 of classic
+        # binary; the expected ChaCha/Salsa throughput champions
+        for prf_blk in (dpf_tpu.PRF_CHACHA20_BLK, dpf_tpu.PRF_SALSA20_BLK):
+            tune(65536, 512, prf_blk, radix=4)
+            tune(65536, 512, prf_blk, radix=4, kernel_impl="pallas")
+            tune(65536, 512, prf_blk, kernel_impl="xla")
+            tune(65536, 512, prf_blk, radix=4, kernel_impl="dispatch")
         # Re-measure the AES-headline winner at headline reps as a
         # "headline" row: bench.py prefers headline rows over raw sweep
         # rows, keeping the round-over-round metric definition fixed
@@ -201,6 +212,11 @@ def main():
             for prf in (dpf_tpu.PRF_AES128, dpf_tpu.PRF_SALSA20,
                         dpf_tpu.PRF_CHACHA20):
                 guard("table", perf, "table", n, 512, prf, reps=5)
+            # block-PRG rows (beyond the reference's table): radix-4 +
+            # one core per node — the framework's fastest stream configs
+            for prf in (dpf_tpu.PRF_SALSA20_BLK, dpf_tpu.PRF_CHACHA20_BLK):
+                guard("table", perf, "table", n, 512, prf, reps=5,
+                      radix=4)
 
     # ---- single-query latency ----
     if "latency" in stages:
@@ -217,7 +233,8 @@ def main():
         # low-latency construction for mid-N (the reference serves this
         # regime with the coop kernel, dpf_gpu/dpf/dpf_coop.cu:3-9)
         for n in (1 << 14, 1 << 16, 1 << 17):
-            for prf in (dpf_tpu.PRF_CHACHA20, dpf_tpu.PRF_AES128):
+            for prf in (dpf_tpu.PRF_CHACHA20, dpf_tpu.PRF_AES128,
+                        dpf_tpu.PRF_CHACHA20_BLK):
                 def lat_sq(n=n, prf=prf):
                     cfg = cfg_for(prf, 1, scheme="sqrtn")
                     r = test_dpf_latency(N=n, prf=prf, quiet=True,
@@ -230,6 +247,8 @@ def main():
         for n in (1 << 22, 1 << 24, 1 << 26):
             for prf in (dpf_tpu.PRF_CHACHA20, dpf_tpu.PRF_AES128):
                 guard("large", perf, "large", n, 64, prf, reps=3)
+            guard("large", perf, "large", n, 64,
+                  dpf_tpu.PRF_CHACHA20_BLK, reps=3, radix=4)
 
     # ---- PRF zoo ----
     if "zoo" in stages:
@@ -265,6 +284,8 @@ def main():
             emit("profile", {"config": name, "trace_dir": path})
         guard("profile", prof, dpf_tpu.PRF_CHACHA20, "chacha_65536_b512")
         guard("profile", prof, dpf_tpu.PRF_AES128, "aes_dispatch_65536_b512")
+        guard("profile", prof, dpf_tpu.PRF_CHACHA20_BLK,
+              "chacha_blk_65536_b512")
 
     # "done" only if at least one stage produced real data; the keepalive
     # loop keys off this flag, and a session where every guarded stage
