@@ -43,9 +43,13 @@ def load():
         return _lib
     _tried = True
     src_dir = os.path.join(_THIS, "src")
-    newest_src = max(os.path.getmtime(os.path.join(src_dir, f))
-                     for f in os.listdir(src_dir))
-    if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < newest_src:
+    try:
+        newest_src = max(os.path.getmtime(os.path.join(src_dir, f))
+                         for f in os.listdir(src_dir))
+    except (OSError, ValueError):
+        newest_src = None  # no sources shipped: use a prebuilt lib as-is
+    if not os.path.exists(_LIB) or (newest_src is not None
+                                    and os.path.getmtime(_LIB) < newest_src):
         if not _build():
             return None
     try:

@@ -88,12 +88,6 @@ def main():
                                      test_matmul_perf)
     from dpf_tpu.utils.config import EvalConfig
 
-    PRF_NAMES = {dpf_tpu.PRF_SALSA20: "SALSA20",
-                 dpf_tpu.PRF_CHACHA20: "CHACHA20",
-                 dpf_tpu.PRF_AES128: "AES128",
-                 dpf_tpu.PRF_SALSA20_BLK: "SALSA20_BLK",
-                 dpf_tpu.PRF_CHACHA20_BLK: "CHACHA20_BLK"}
-
     def cfg_for(prf, batch, **kw):
         # AES always via dispatch mode (monolithic bitsliced compile can
         # outlive any watchdog through the relay; docs/STATUS.md)

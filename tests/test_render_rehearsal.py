@@ -1,0 +1,136 @@
+"""Dress rehearsal of the full measured-results render pipeline.
+
+The renderers (``scripts/report.py`` README block + docs/MEASURED.md,
+``experiments/scaling_projection.py`` docs/SCALING.md) had only ever been
+unit-tested on hand-written rows — the first real TPU session could
+surface schema drift (round-4 verdict, "rendering pipeline untested
+against real data").  This test closes that gap as far as possible
+without the chip: the row dicts come from the REAL measurement harness
+(``utils.bench.test_dpf_perf`` / ``test_dpf_latency`` executed on CPU at
+tiny shapes — the same code path the TPU session runs), wrapped with the
+exact ``emit()`` envelope of ``experiments/tpu_all.py``, spanning every
+stage the session emits, then rendered end to end into temp outputs.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import dpf_tpu
+from dpf_tpu.utils.bench import test_dpf_latency as _dpf_latency
+from dpf_tpu.utils.bench import test_dpf_perf as _dpf_perf
+from dpf_tpu.utils.config import EvalConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _session_rows():
+    """A realistic full session: real harness dicts, tpu_all envelope."""
+    sid = "9999.%d" % int(time.time())
+    t = [time.time()]
+
+    def emit(stage, rec):
+        rec = dict(rec)
+        rec["stage"] = stage
+        rec["sid"] = sid
+        t[0] += 1.0
+        rec["t"] = round(t[0], 1)
+        return rec
+
+    rows = [emit("probe", {"devices": ["FakeTpuDevice(id=0)"],
+                           "probe_s": 2.0})]
+
+    # one REAL measured row per (stage-kind, schema variant); values are
+    # then transplanted onto the (entries, prf) grid the renderers key on
+    base = _dpf_perf(N=1024, batch=8, prf=dpf_tpu.PRF_CHACHA20,
+                     reps=2, quiet=True, check=True,
+                     config=EvalConfig(prf_method=dpf_tpu.PRF_CHACHA20,
+                                       batch_size=8))
+    blk = _dpf_perf(N=1024, batch=8, prf=dpf_tpu.PRF_CHACHA20_BLK,
+                    reps=2, quiet=True, check=True,
+                    config=EvalConfig(
+                        prf_method=dpf_tpu.PRF_CHACHA20_BLK,
+                        radix=4, batch_size=8))
+    lat = _dpf_latency(N=1024, prf=dpf_tpu.PRF_CHACHA20, reps=2,
+                       quiet=True)
+
+    def perf_row(stage, n, prf_name, rate, knobs=None, src=None):
+        r = dict(src or base)
+        r.update(entries=n, prf=prf_name, batch_size=512,
+                 dpfs_per_sec=rate, knobs=knobs or {})
+        return emit(stage, r)
+
+    rows.append(perf_row("headline", 65536, "AES128", 17000,
+                         {"aes_impl": "bitsliced:bp"}))
+    for n, rates in {
+            16384: (52000, 150000, 149000, 260000),
+            65536: (16000, 55000, 56500, 98000),
+            262144: (4000, 16500, 16400, 30000),
+            1048576: (930, 3900, 4000, 7600)}.items():
+        aes, sal, cha, chb = rates
+        rows += [perf_row("table", n, "AES128", aes),
+                 perf_row("table", n, "SALSA20", sal),
+                 perf_row("table", n, "CHACHA20", cha),
+                 perf_row("table", n, "CHACHA20_BLK", chb,
+                          {"radix": 4}, src=blk),
+                 perf_row("table", n, "SALSA20_BLK", chb - 1000,
+                          {"radix": 4}, src=blk)]
+    rows += [perf_row("tuning", 65536, "AES128", 15500,
+                      {"aes_impl": "bitsliced:tower"}),
+             perf_row("tuning", 65536, "CHACHA20_BLK", 97000,
+                      {"radix": 4, "kernel_impl": "pallas"}, src=blk)]
+    for n in (1 << 22, 1 << 24):
+        rows.append(perf_row("large", n, "CHACHA20_BLK",
+                             (1 << 26) // n * 110, {"radix": 4}, src=blk))
+    for n in (16384, 65536):
+        r = dict(lat)
+        r.update(entries=n, latency_ms=1.2 * (n / 16384))
+        rows.append(emit("latency", r))
+    rows.append(emit("zoo", {"prf_calls_per_sec":
+                             {"chacha20_12": 1_000_000,
+                              "aes128_bitsliced": 400_000}}))
+    rows.append(emit("matmul", {"impl": "i32", "B": 512, "K": 65536,
+                                "E": 16, "elapsed_s": 0.5,
+                                "gemms_per_sec": 1000.0}))
+    rows.append(emit("profile", {"config": "chacha_65536_b512",
+                                 "trace_dir": "tpu_traces/x"}))
+    rows.append(emit("session", {"done": True, "n_ok": len(rows)}))
+    return rows
+
+
+def test_render_pipeline_end_to_end(tmp_path):
+    rows = _session_rows()
+    results = tmp_path / "tpu_results.jsonl"
+    with open(results, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "# x\n<!-- MEASURED:BEGIN -->\n<!-- MEASURED:END -->\n")
+    doc = tmp_path / "MEASURED.md"
+
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "report.py"),
+         "--results", str(results), "--out-doc", str(doc),
+         "--readme", str(readme), "--round-start", "0"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    md = doc.read_text()
+    # headline + throughput table + blk rows/footnote + latency + roofline
+    assert "17000" in md and "vs V100" in md
+    assert "CHACHA20_BLK" in md and "_BLK` rows serve" in md
+    assert "Latency" in md or "latency" in md
+    rm = readme.read_text()
+    assert "17000" in rm  # README measured block populated
+
+    scaling = tmp_path / "SCALING.md"
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "experiments", "scaling_projection.py"),
+         "--results", str(results), "--out", str(scaling)],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    sc = scaling.read_text()
+    assert "2^32" in sc and "CHACHA20_BLK" in sc
