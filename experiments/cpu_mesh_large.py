@@ -38,15 +38,22 @@ def main():
     ap.add_argument("--batch", type=int, default=4)
     ap.add_argument("--entry-size", type=int, default=16)
     ap.add_argument("--deadline-s", type=int, default=3600)
+    from dpf_tpu.core.prf_ref import PRF_NAMES
+    ap.add_argument("--prf", default="CHACHA20",
+                    choices=sorted(PRF_NAMES.values()),
+                    help="PRF name, e.g. CHACHA20 or CHACHA20_BLK")
+    ap.add_argument("--radix", type=int, default=2, choices=(2, 4))
     ap.add_argument("--out", default="cpu_mesh_results.jsonl")
     args = ap.parse_args()
     deadline = time.time() + args.deadline_s
 
     import numpy as np
 
-    from dpf_tpu import DPF, PRF_CHACHA20
+    from dpf_tpu import DPF
     from dpf_tpu.parallel import sharded
+    from dpf_tpu.utils.config import EvalConfig
 
+    prf_id = {v: k for k, v in PRF_NAMES.items()}[args.prf]
     out = open(args.out, "a", buffering=1)
 
     def emit(rec):
@@ -56,7 +63,7 @@ def main():
         print(line, flush=True)
 
     mesh = sharded.make_mesh(n_table=8, n_batch=1)
-    dpf = DPF(prf=PRF_CHACHA20)
+    dpf = DPF(config=EvalConfig(prf_method=prf_id, radix=args.radix))
     rng = np.random.default_rng(0)
 
     for log_n in range(args.min_log_n, args.max_log_n + 1):
@@ -79,7 +86,8 @@ def main():
                  + np.arange(args.entry_size, dtype=np.uint32)[None, :]
                  * np.uint32(40503)).view(np.int32)
         srv = sharded.ShardedDPFServer(
-            table, mesh, prf_method=PRF_CHACHA20, batch_size=args.batch)
+            table, mesh, prf_method=prf_id, batch_size=args.batch,
+            radix=args.radix)
         t_build = time.time() - t_build
 
         idxs = [int(rng.integers(0, n)) for _ in range(args.batch)]
@@ -92,7 +100,8 @@ def main():
         ok = bool((rec == table[idxs]).all())
         emit({"stage": "cpu_mesh_large", "log_n": log_n, "n": n,
               "batch": args.batch, "entry_size": args.entry_size,
-              "mesh": dict(mesh.shape), "prf": "CHACHA20",
+              "mesh": dict(mesh.shape), "prf": args.prf,
+              "radix": args.radix,
               "recovered_ok": ok, "build_s": round(t_build, 1),
               "eval2_wall_s": round(wall, 1),
               "table_mib": round(table.nbytes / 2 ** 20, 1)})
