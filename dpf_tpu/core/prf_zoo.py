@@ -8,7 +8,9 @@ round-count variants (Salsa20/8, Salsa20/20, ChaCha8, ChaCha20, ...) and a
 throughput benchmark to compare candidates on real hardware.
 
 NOTE: zoo variants are NOT wire-compatible with the reference keys — they
-exist for PRF-selection studies, like the paper's.  Of the 13 candidates,
+exist for PRF-selection studies, like the paper's.  15 candidates: the
+paper's 13 plus the two block-PRG additions (``chacha12_blk`` /
+``salsa20_12_blk``, 4 GGM children per core call).  Of these,
 ``highway_proxy`` is an op-mix *proxy* for the HighwayHash family (same
 instruction mix and widths, NOT the published constants/algorithm — see
 ``prf_zoo_hash.py``); every summary of the zoo should carry that asterisk.
@@ -99,11 +101,37 @@ ZOO = {
 }
 
 
+def _blk_candidate(words_fn):
+    def fn(seeds, pos: int):
+        from .prf import _prf_blk
+        return _prf_blk(lambda s, c: words_fn(s, c, None), seeds, pos)
+    return fn
+
+
+def _init_blk_candidates():
+    """Block-PRG candidates (core/prf_ref.py::prf_*_blk): one core call
+    yields FOUR GGM children, so their selection metric is children/sec
+    = 4x their calls/sec (``CHILDREN_PER_CALL``)."""
+    from .prf import _chacha20_12_words_jax, _salsa20_12_words_jax
+    ZOO["chacha12_blk"] = _blk_candidate(_chacha20_12_words_jax)
+    ZOO["salsa20_12_blk"] = _blk_candidate(_salsa20_12_words_jax)
+
+
+_init_blk_candidates()
+
+# GGM children produced per candidate call (default 1): the DPF cost
+# model counts children, so benchmark_zoo scales by this
+CHILDREN_PER_CALL = {"chacha12_blk": 4, "salsa20_12_blk": 4}
+
+
 def benchmark_zoo(n_calls=1 << 20, reps=5, names=None):
     """Throughput of each candidate on the default backend.
 
-    Returns {name: prf_calls_per_sec}; prints one result-dict line per
-    candidate (the paper's PRF-selection experiment, on TPU).
+    Returns {name: ggm_children_per_sec} — calls/sec scaled by
+    ``CHILDREN_PER_CALL`` (1 for classic per-child PRFs, 4 for the
+    block-PRG candidates), the metric the DPF cost model actually
+    selects on.  Prints one result-dict line per candidate (the paper's
+    PRF-selection experiment, on TPU).
     """
     import json
 
@@ -122,8 +150,10 @@ def benchmark_zoo(n_calls=1 << 20, reps=5, names=None):
             out = fn(seeds)
         out.block_until_ready()
         per_sec = n_calls * reps / (time.time() - t0)
-        results[name] = per_sec
+        kids = CHILDREN_PER_CALL.get(name, 1)
+        results[name] = per_sec * kids
         print(json.dumps({"prf_candidate": name, "calls": n_calls,
-                          "reps": reps,
-                          "prf_calls_per_sec": int(per_sec)}))
+                          "reps": reps, "children_per_call": kids,
+                          "prf_calls_per_sec": int(per_sec),
+                          "ggm_children_per_sec": int(per_sec * kids)}))
     return results

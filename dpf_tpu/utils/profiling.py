@@ -25,6 +25,84 @@ def trace(config_name: str, base_dir: str = "/tmp/dpf_tpu_traces"):
         jax.profiler.stop_trace()
 
 
+def _self_times(track_events):
+    """(name, self_us) per complete event of ONE track, with nested
+    children's durations subtracted from their parents (host python
+    stacks and runtime tracks nest; summing raw durations would count
+    a frame once per ancestor)."""
+    evs = sorted(track_events,
+                 key=lambda e: (float(e.get("ts", 0)),
+                                -float(e.get("dur", 0))))
+    out = []
+    stack = []  # indices into out; parents below children
+    for e in evs:
+        ts = float(e.get("ts", 0))
+        dur = float(e.get("dur", 0))
+        while stack and stack[-1][0] <= ts:
+            stack.pop()
+        if stack:
+            parent = stack[-1][1]
+            out[parent][1] -= dur
+        out.append([str(e.get("name", "?"))[:80], dur])
+        stack.append((ts + dur, len(out) - 1))
+    return out
+
+
+def summarize_trace(trace_dir: str, top: int = 12):
+    """Digest a captured trace into {device_ms, top_ops} (or None).
+
+    Reads the Chrome-trace export (``*.trace.json.gz``) the profiler
+    writes next to the xplane protobuf, picks the op-level tracks —
+    "XLA Ops" threads (TPU device traces), else ``tf_XLA*`` runtime
+    threads (CPU backend), else everything — and aggregates SELF time
+    per op name (module/parent rows span their children and would
+    otherwise double-count).  The digest is small enough to live as a
+    row in the measurement JSONL, so the TPU session's profile stage
+    records WHERE the time went (the ncu-report role,
+    ``paper/kernel/gpu/Makefile:24-32``) even if the raw trace
+    directory is lost.
+    """
+    import glob
+    import gzip
+    import json as _json
+
+    paths = sorted(glob.glob(os.path.join(
+        trace_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not paths:
+        return None
+    with gzip.open(paths[-1], "rt") as f:
+        events = _json.load(f).get("traceEvents", [])
+    thread_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            thread_names[(e.get("pid"), e.get("tid"))] = str(
+                e.get("args", {}).get("name", ""))
+    tracks = {}
+    for e in events:
+        if e.get("ph") == "X":
+            tracks.setdefault((e.get("pid"), e.get("tid")), []).append(e)
+
+    def pick(pred):
+        return {k: v for k, v in tracks.items()
+                if pred(thread_names.get(k, ""))}
+    chosen = pick(lambda n: "XLA Ops" in n)          # TPU device tracks
+    if not chosen:
+        chosen = pick(lambda n: n.startswith("tf_XLA"))  # CPU runtime
+    if not chosen:
+        chosen = tracks
+    by_op = {}
+    total_us = 0.0
+    for track in chosen.values():
+        for name, self_us in _self_times(track):
+            total_us += self_us
+            by_op[name] = by_op.get(name, 0.0) + self_us
+    ops = sorted(by_op.items(), key=lambda kv: -kv[1])[:top]
+    return {"trace_file": os.path.basename(paths[-1]),
+            "device_ms": round(total_us / 1e3, 3),
+            "top_ops": [{"op": k, "ms": round(v / 1e3, 3)}
+                        for k, v in ops]}
+
+
 class Timer:
     """Wall-clock block timer that blocks on device completion."""
 

@@ -249,7 +249,9 @@ def main():
         def zoo():
             from dpf_tpu.core.prf_zoo import benchmark_zoo
             res = benchmark_zoo(n_calls=1 << 20, reps=5)
-            emit("zoo", {"prf_calls_per_sec":
+            # children/sec (= calls/sec x children-per-call) — the
+            # metric the DPF cost model selects on
+            emit("zoo", {"ggm_children_per_sec":
                          {k: int(v) for k, v in res.items()}})
         guard("zoo", zoo)
 
@@ -267,6 +269,7 @@ def main():
         from dpf_tpu.utils.profiling import trace
 
         def prof(prf, name):
+            from dpf_tpu.utils.profiling import summarize_trace
             n, batch = 65536, 512
             cfg = cfg_for(prf, batch)
             dpf = dpf_tpu.DPF(prf=prf, config=cfg)
@@ -275,7 +278,11 @@ def main():
             dpf.eval_tpu([k1] * batch)  # compile + warm outside the trace
             with trace(name, base_dir="tpu_traces") as path:
                 dpf.eval_tpu([k1] * batch)
-            emit("profile", {"config": name, "trace_dir": path})
+            rec = {"config": name, "trace_dir": path}
+            summary = summarize_trace(path)
+            if summary:  # op-level digest survives in the JSONL even if
+                rec.update(summary)  # the raw trace directory is lost
+            emit("profile", rec)
         guard("profile", prof, dpf_tpu.PRF_CHACHA20, "chacha_65536_b512")
         guard("profile", prof, dpf_tpu.PRF_AES128, "aes_dispatch_65536_b512")
         guard("profile", prof, dpf_tpu.PRF_CHACHA20_BLK,

@@ -88,8 +88,9 @@ def _session_rows():
         r = dict(lat)
         r.update(entries=n, latency_ms=1.2 * (n / 16384))
         rows.append(emit("latency", r))
-    rows.append(emit("zoo", {"prf_calls_per_sec":
-                             {"chacha20_12": 1_000_000,
+    rows.append(emit("zoo", {"ggm_children_per_sec":
+                             {"chacha12_blk": 4_000_000,
+                              "chacha20_12": 1_000_000,
                               "aes128_bitsliced": 400_000}}))
     rows.append(emit("matmul", {"impl": "i32", "B": 512, "K": 65536,
                                 "E": 16, "elapsed_s": 0.5,
