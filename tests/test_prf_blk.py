@@ -207,6 +207,25 @@ def test_blk_sqrtn_grid():
         assert ((a - b).astype(np.int32)[0] == table[42]).all(), m
 
 
+def test_blk_grid_vals_row_tail():
+    """_grid_vals with a row count NOT a multiple of 4: the last block's
+    unused groups are sliced away and every produced row still matches
+    the scalar pos semantics."""
+    from dpf_tpu.core.sqrtn import _grid_vals
+    keys = _seeds(4, seed=9)
+    ints = u128.limbs_to_ints(keys)
+    for m in BLK:
+        for r in (2, 5, 7):
+            vals = _grid_vals(
+                m, lambda nr: np.broadcast_to(keys[None, :, :],
+                                              (nr, 4, 4)), r, np)
+            assert vals.shape == (r, 4, 4)
+            for row in range(r):
+                got = list(u128.limbs_to_ints(vals[row]))
+                want = [prf_ref.prf(m, s, row) for s in ints]
+                assert got == want, (m, r, row)
+
+
 def test_blk_native_parity():
     from dpf_tpu import native
     if native.load() is None:  # pragma: no cover - compiler always present
