@@ -184,6 +184,39 @@ def _cached_headline(n, path=None, since=None):
     return pick(sess) or pick(incomplete) or pick(any_round)
 
 
+def _relay_health():
+    """One-line health-probe timeline from the keepalive log (or None)
+    — attached to failure reports so the driver-recorded BENCH json
+    itself proves whether the relay was down (round-4 verdict: a
+    relay-down round must show the probe timeline)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    log = os.path.join(repo, "tpu_keepalive.log")
+    try:
+        scripts = os.path.join(repo, "scripts")
+        if scripts not in sys.path:
+            sys.path.insert(0, scripts)
+        from relay_timeline import summarize
+        line = summarize(log)
+        # summarize's own can't-read/no-attempts strings are not
+        # evidence — report nothing rather than noise
+        if line.startswith("relay timeline (%s): " % log):
+            return line
+        return None
+    except Exception:
+        return None
+
+
+def _fail(value_n, msg, exit_code=2):
+    """Print a failure result (with the relay-health timeline attached
+    when available) and exit."""
+    extra = {"error": msg}
+    health = _relay_health()
+    if health:
+        extra["relay_health"] = health
+    _result(0, value_n, extra)
+    sys.exit(exit_code)
+
+
 def _other_claimant():
     """PID + cmdline of a live TPU claimant process (the keepalive
     session or another bench worker), or None.  Never add a second
@@ -266,12 +299,10 @@ def main():
             return
         claimant = _other_claimant()
         if claimant:
-            _result(0, n, {"error": "another TPU claimant is alive (%s); "
-                                    "refusing a second concurrent claim "
-                                    "(grant-contention discipline, "
-                                    "docs/STATUS.md) and no measured "
-                                    "headline is on disk yet" % claimant})
-            sys.exit(2)
+            _fail(n, "another TPU claimant is alive (%s); refusing a "
+                     "second concurrent claim (grant-contention "
+                     "discipline, docs/STATUS.md) and no measured "
+                     "headline is on disk yet" % claimant)
 
     # Principal mutual exclusion vs the keepalive loop (which flocks the
     # same file for its whole lifetime): no lock, no claim.  The worker
@@ -309,10 +340,9 @@ def main():
     if not probed:  # final re-read: PROBE_OK may land during the last sleep
         probed = "PROBE_OK" in read_log()
     if not probed and worker.poll() is None:
-        _result(0, n, {"error": "TPU relay unresponsive to the worker's "
-                                "tiny probe program after %ds (wedged); "
-                                "worker abandoned, not killed" % PROBE_S})
-        sys.exit(2)
+        _fail(n, "TPU relay unresponsive to the worker's tiny probe "
+                 "program after %ds (wedged); worker abandoned, not "
+                 "killed" % PROBE_S)
 
     # Phase 2: wait for the result line.
     rc = None
@@ -329,13 +359,10 @@ def main():
         print(line, flush=True)
         return
     if rc is None:
-        _result(0, n, {"error": "TPU backend unresponsive after %ds "
-                                "(relay wedged mid-run?); worker "
-                                "abandoned, not killed" % WATCHDOG_S})
-        sys.exit(2)
-    _result(0, n, {"error": "worker exited rc=%s; tail: %s"
-                            % (rc, out[-300:])})
-    sys.exit(3)
+        _fail(n, "TPU backend unresponsive after %ds (relay wedged "
+                 "mid-run?); worker abandoned, not killed" % WATCHDOG_S)
+    _fail(n, "worker exited rc=%s; tail: %s" % (rc, out[-300:]),
+          exit_code=3)
 
 
 if __name__ == "__main__":
