@@ -83,6 +83,19 @@ def main():
                                               str(e)[:300])})
             return None
 
+    # Persistent compilation cache: a session retry after a mid-run
+    # wedge (or a later round) reuses every executable already compiled
+    # for identical (program, flags) keys instead of paying the relay
+    # compile again.  Best-effort — harmless if the backend ignores it.
+    try:
+        import jax
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(repo, ".jax_compile_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5)
+    except Exception as e:
+        print("compilation cache unavailable: %s" % e, flush=True)
+
     import dpf_tpu
     from dpf_tpu.utils.bench import (test_dpf_latency, test_dpf_perf,
                                      test_matmul_perf)

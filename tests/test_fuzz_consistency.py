@@ -20,7 +20,8 @@ def _random_configs(k):
     for _ in range(k):
         n = 1 << int(RNG.integers(7, 12))
         alpha = int(RNG.integers(0, n))
-        prf = int(RNG.choice([0, 0, 1, 2]))  # bias to cheap DUMMY
+        # bias to cheap DUMMY; 4/5 are the block-PRG stream variants
+        prf = int(RNG.choice([0, 0, 1, 2, 4, 5]))
         yield n, alpha, prf
 
 
