@@ -337,6 +337,34 @@ def test_main_reports_cached_row_even_with_live_claimant(tmp_path):
         fake.wait()
 
 
+def test_relay_timeline_summary_format(tmp_path):
+    """bench.py attaches relay_timeline.summarize() output to failure
+    reports iff it startswith the evidence prefix — pin both the happy
+    format and the no-evidence strings."""
+    sys.path.insert(0, os.path.join(REPO, "scripts"))
+    from relay_timeline import summarize
+    log = tmp_path / "ka.log"
+    log.write_text(
+        "keepalive: attempt 1 at 08:00:00\n"
+        "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE\n"
+        "keepalive: attempt 2 at 08:27:00\n"
+        "RuntimeError: Unable to initialize backend 'axon': UNAVAILABLE\n"
+        "keepalive: attempt 3 at 08:54:00\n")
+    line = summarize(str(log))
+    assert line.startswith("relay timeline (%s): " % log)
+    assert "3 claimant attempts" in line
+    assert "2 terminal UNAVAILABLE" in line and "1 other" in line
+    assert "27m00s" in line
+    # no-evidence cases do NOT carry the evidence prefix bench.py keys on
+    empty = tmp_path / "empty.log"
+    empty.write_text("nothing here\n")
+    assert not summarize(str(empty)).startswith(
+        "relay timeline (%s): " % empty)
+    missing = str(tmp_path / "missing.log")
+    assert not summarize(missing).startswith(
+        "relay timeline (%s): " % missing)
+
+
 def test_flock_exec_arbitrates_on_the_bench_lock_file(tmp_path):
     """scripts/flock_exec.py (the no-flock(1) keepalive fallback) must
     exclude against the SAME fcntl lock bench.py takes: holding the
