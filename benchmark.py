@@ -1,10 +1,19 @@
 # benchmark.py — sweep table sizes x PRFs and print dpfs/sec
 # (mirrors the reference's benchmark.py:1-7 sweep protocol).
+#
+# benchmark.py --serve runs the streaming serving benchmark instead
+# (blocking loop vs pipelined ServingEngine, dpf_tpu/serve/bench_serve.py).
+
+import sys
 
 import dpf_tpu
 from dpf_tpu.utils.bench import test_dpf_perf
 
 if __name__ == "__main__":
+    if "--serve" in sys.argv:
+        from dpf_tpu.serve.bench_serve import main
+        main([a for a in sys.argv[1:] if a != "--serve"])
+        sys.exit(0)
     for n in [16384, 65536, 262144, 1048576]:
         for prf in [dpf_tpu.PRF_AES128, dpf_tpu.PRF_SALSA20,
                     dpf_tpu.PRF_CHACHA20]:

@@ -244,17 +244,15 @@ class DPF(object):
     eval_gpu = eval_tpu
 
     def _pack_batch(self, keys):
-        """Deserialize + validate a key batch -> (packed arrays, n,
-        torch-ness of the inputs)."""
-        if not keys:
+        """Decode + validate a key batch -> (packed arrays, n, torch-ness
+        of the inputs).  Uses the vectorized batched codec
+        (``keygen.decode_keys_batched``) — one stacked buffer, O(1)
+        Python decode ops — instead of the per-key scalar loop."""
+        if not len(keys):
             raise ValueError("empty key batch")
         torch_io = any(_is_torch(k) for k in keys)
-        flat = [keygen.deserialize_key(k) for k in keys]
-        n = flat[0].n
-        for fk in flat:
-            if fk.n != n:
-                raise ValueError("keys for mixed table sizes")
-        return expand.pack_keys(flat), n, torch_io
+        pk = keygen.decode_keys_batched(keys)
+        return (pk.cw1, pk.cw2, pk.last), pk.n, torch_io
 
     def eval_one_hot(self, keys):
         """Accelerated full one-hot expansion (a reference TODO,
@@ -360,15 +358,36 @@ class DPF(object):
     def _eval_batch(self, keys) -> np.ndarray:
         if self.scheme == "sqrtn":
             return self._eval_batch_sqrt(keys)
+        return np.asarray(self._dispatch_packed(self._decode_batch(keys)))
+
+    def _decode_batch(self, keys) -> keygen.PackedKeys:
+        """Vectorized ingest: wire keys -> PackedKeys, validated against
+        the initialized table (shared with the serving engine)."""
+        if self.scheme == "sqrtn":
+            raise NotImplementedError(
+                "scheme='sqrtn' has no packed-batch codec; use eval_tpu")
         if self.radix == 4:
-            return self._eval_batch_r4(keys)
-        flat = [keygen.deserialize_key(k) for k in keys]
+            from .core import radix4
+            pk = radix4.decode_mixed_keys_batched(keys)
+        else:
+            pk = keygen.decode_keys_batched(keys)
         n = self.table_num_entries
-        for fk in flat:
-            if fk.n != n:
-                raise ValueError(
-                    "key generated for n=%d but table has n=%d" % (fk.n, n))
-        cw1, cw2, last = expand.pack_keys(flat)
+        if n is not None and pk.n != n:
+            raise ValueError(
+                "key generated for n=%d but table has n=%d" % (pk.n, n))
+        return pk
+
+    def _dispatch_packed(self, pk: keygen.PackedKeys):
+        """Dispatch one packed batch to the device and return the device
+        array WITHOUT forcing a host sync: JAX async dispatch lets the
+        caller (the serving engine) pack the next batch while this one
+        runs.  Blocking callers wrap the result in ``np.asarray``."""
+        if self.table_device is None:
+            raise RuntimeError("Must call `eval_init` before dispatch")
+        if self.radix == 4:
+            return self._dispatch_packed_r4(pk)
+        cw1, cw2, last = pk.cw1, pk.cw2, pk.last
+        n = self.table_num_entries
         depth = n.bit_length() - 1
         kernel_impl = self._config.kernel_impl if self._config else "xla"
         if self._config and self._config.chunk_leaves:
@@ -379,7 +398,7 @@ class DPF(object):
             from .ops.pallas_level import pallas_chunk_leaves
             chunk = pallas_chunk_leaves(n)
         else:
-            chunk = expand.choose_chunk(n, len(flat))
+            chunk = expand.choose_chunk(n, pk.batch)
         chunk = min(chunk, n)
         if n % chunk:
             raise ValueError(
@@ -404,13 +423,12 @@ class DPF(object):
                 dot_impl=dot_impl, aes_impl=aes_impl,
                 round_unroll=round_unroll,
                 deadline=self.dispatch_deadline)
-            return np.asarray(out)
-        out = expand.expand_and_contract(
+            return out
+        return expand.expand_and_contract(
             cw1, cw2, last, self.table_device, depth=depth,
             prf_method=self.prf_method, chunk_leaves=chunk,
             dot_impl=dot_impl, aes_impl=aes_impl,
             round_unroll=round_unroll, kernel_impl=kernel_impl)
-        return np.asarray(out)
 
     def _mixed_batch(self, keys):
         """Deserialize + validate a radix-4 key batch (uniform n)."""
@@ -423,21 +441,17 @@ class DPF(object):
                 raise ValueError("keys for mixed table sizes")
         return mk
 
-    def _eval_batch_r4(self, keys) -> np.ndarray:
-        """Radix-4 device evaluation (core/radix4.py engines)."""
+    def _dispatch_packed_r4(self, pk: keygen.PackedKeys):
+        """Radix-4 device dispatch (core/radix4.py engines), async like
+        ``_dispatch_packed``."""
         from .core import prf as _prf
         from .core import radix4
         from .ops import matmul128
-        mk = self._mixed_batch(keys)
+        cw1, cw2, last = pk.cw1, pk.cw2, pk.last
         n = self.table_num_entries
-        for k in mk:
-            if k.n != n:
-                raise ValueError(
-                    "key generated for n=%d but table has n=%d" % (k.n, n))
-        cw1, cw2, last = radix4.pack_mixed_keys(mk)
         cfg = self._config
         chunk = (cfg.chunk_leaves if cfg and cfg.chunk_leaves
-                 else expand.choose_chunk(n, len(mk)))
+                 else expand.choose_chunk(n, pk.batch))
         dot_impl = cfg.dot_impl if cfg else matmul128.default_impl()
         aes_impl = (cfg.aes_impl if cfg and cfg.aes_impl != "auto"
                     else _prf._aes_pair_impl())
@@ -462,7 +476,7 @@ class DPF(object):
                 prf_method=self.prf_method, chunk_leaves=chunk,
                 dot_impl=dot_impl, aes_impl=aes_impl,
                 round_unroll=round_unroll)
-        return np.asarray(out)
+        return out
 
     # ------------------------------------------------------------ eval_cpu
 
@@ -506,6 +520,19 @@ class DPF(object):
             hots = np.stack([evalref.eval_one_hot_i32(fk, self.prf_method)
                              for fk in flat])  # [B, N] int32
         return hots
+
+    # ------------------------------------------------------- serving_engine
+
+    def serving_engine(self, **kwargs):
+        """Construct a throughput-oriented ``ServingEngine`` over this
+        DPF's initialized table (``serve/engine.py``): vectorized key
+        ingest, double-buffered async dispatch, shape-bucketed batching.
+
+        kwargs forward to ``ServingEngine`` (``max_in_flight``,
+        ``buckets``, ``warmup``).  Requires a prior ``eval_init``.
+        """
+        from .serve import ServingEngine
+        return ServingEngine(self, **kwargs)
 
     # ------------------------------------------------------------ eval_free
 

@@ -442,13 +442,14 @@ def eval_points(cw1, cw2, last, indices, *, depth: int, prf_method: int,
 
 
 def pack_keys(flat_keys) -> tuple:
-    """List of FlatKey -> (cw1 [B,64,4], cw2, last [B,4]) uint32 arrays."""
-    bsz = len(flat_keys)
-    cw1 = np.zeros((bsz, MAX_CW, 4), dtype=np.uint32)
-    cw2 = np.zeros((bsz, MAX_CW, 4), dtype=np.uint32)
-    last = np.zeros((bsz, 4), dtype=np.uint32)
-    for i, k in enumerate(flat_keys):
-        cw1[i] = k.cw1
-        cw2[i] = k.cw2
-        last[i] = u128.int_to_limbs(k.last_key)
+    """List of FlatKey -> (cw1 [B,64,4], cw2, last [B,4]) uint32 arrays.
+
+    Scalar-codec packing (the batched wire path is
+    ``keygen.decode_keys_batched``, which skips FlatKey entirely); the
+    stacks here run at C level, only last_key needs per-key limb
+    conversion.
+    """
+    cw1 = np.stack([k.cw1 for k in flat_keys]).astype(np.uint32, copy=False)
+    cw2 = np.stack([k.cw2 for k in flat_keys]).astype(np.uint32, copy=False)
+    last = np.stack([u128.int_to_limbs(k.last_key) for k in flat_keys])
     return cw1, cw2, last

@@ -108,6 +108,9 @@ def _blk_candidate(words_fn):
     return fn
 
 
+_BLK_WORDS_FNS = {}  # name -> (seeds, ctr, unroll) 16-word core closure
+
+
 def _init_blk_candidates():
     """Block-PRG candidates (core/prf_ref.py::prf_*_blk): one core call
     yields FOUR GGM children, so their selection metric is children/sec
@@ -115,6 +118,8 @@ def _init_blk_candidates():
     from .prf import _chacha20_12_words_jax, _salsa20_12_words_jax
     ZOO["chacha12_blk"] = _blk_candidate(_chacha20_12_words_jax)
     ZOO["salsa20_12_blk"] = _blk_candidate(_salsa20_12_words_jax)
+    _BLK_WORDS_FNS["chacha12_blk"] = _chacha20_12_words_jax
+    _BLK_WORDS_FNS["salsa20_12_blk"] = _salsa20_12_words_jax
 
 
 _init_blk_candidates()
@@ -130,30 +135,47 @@ def benchmark_zoo(n_calls=1 << 20, reps=5, names=None):
     Returns {name: ggm_children_per_sec} — calls/sec scaled by
     ``CHILDREN_PER_CALL`` (1 for classic per-child PRFs, 4 for the
     block-PRG candidates), the metric the DPF cost model actually
-    selects on.  Prints one result-dict line per candidate (the paper's
-    PRF-selection experiment, on TPU).
+    selects on.  For the block-PRG candidates the timed program
+    materializes ALL FOUR 128-bit children from the one core block (the
+    ``prf_multi`` serving path), so the x4 scaling never excludes the
+    extraction cost (ADVICE.md round 5).  Prints one result-dict line
+    per candidate (the paper's PRF-selection experiment, on TPU).
     """
     import json
 
     import jax
     import jax.numpy as jnp
 
+    from .prf import _blk_group
+
     rng = np.random.default_rng(0)
     seeds = jnp.asarray(
         rng.integers(0, 2 ** 32, (n_calls, 4), dtype=np.uint32))
     results = {}
     for name in (names or ZOO):
-        fn = jax.jit(lambda s, f=ZOO[name]: f(s, 1))
-        fn(seeds).block_until_ready()
+        kids = CHILDREN_PER_CALL.get(name, 1)
+        if kids > 1:
+            # one block -> all four children, as prf_multi serves them
+            wf = _BLK_WORDS_FNS[name]
+
+            def all_children(s, wf=wf):
+                out = wf(s, 0, None)
+                return jnp.stack([_blk_group(out, 4 * b)
+                                  for b in range(4)])
+
+            fn = jax.jit(all_children)
+        else:
+            fn = jax.jit(lambda s, f=ZOO[name]: f(s, 1))
+        jax.block_until_ready(fn(seeds))
         t0 = time.time()
         for _ in range(reps):
             out = fn(seeds)
-        out.block_until_ready()
+        jax.block_until_ready(out)
         per_sec = n_calls * reps / (time.time() - t0)
-        kids = CHILDREN_PER_CALL.get(name, 1)
         results[name] = per_sec * kids
         print(json.dumps({"prf_candidate": name, "calls": n_calls,
                           "reps": reps, "children_per_call": kids,
+                          "timed_children_materialized": kids,
                           "prf_calls_per_sec": int(per_sec),
                           "ggm_children_per_sec": int(per_sec * kids)}))
     return results

@@ -139,6 +139,40 @@ def deserialize_mixed_key(arr) -> MixedKey:
                     last_key=u128.limbs_to_int(slots[129]), n=n)
 
 
+def decode_mixed_keys_batched(keys):
+    """Vectorized wire -> packed-arrays codec for a radix-4 key batch.
+
+    The mixed-radix counterpart of ``keygen.decode_keys_batched``:
+    replaces the per-key ``deserialize_mixed_key`` + ``pack_mixed_keys``
+    host loop with one stacked buffer and view/reshape decoding.
+    Returns a ``keygen.PackedKeys`` (cw slots are eval-order blocks at
+    ``cw_offsets`` rather than the binary ``2i + b`` scheme — the packed
+    array layout is identical either way).
+    """
+    from .keygen import PackedKeys, stack_wire_keys
+    slots = stack_wire_keys(keys).view(np.uint32).reshape(-1, 131, 4)
+    if (slots[:, 0, 1] != 4).any():
+        bad = int(np.argmax(slots[:, 0, 1] != 4))
+        raise ValueError("not a mixed-radix key (marker %d)"
+                         % int(slots[bad, 0, 1]))
+    n = (slots[:, 130, 0].astype(np.uint64)
+         | (slots[:, 130, 1].astype(np.uint64) << np.uint64(32)))
+    if (n != n[0]).any():
+        raise ValueError("keys for mixed table sizes")
+    n0 = int(n[0])
+    ars = arities(n0)
+    depth = n0.bit_length() - 1
+    n_bin = sum(1 for x in ars if x == 2)
+    if ((slots[:, 0, 0] != depth) | (slots[:, 0, 2] != n_bin)).any():
+        raise ValueError("mixed-radix key header inconsistent with n=%d"
+                         % n0)
+    return PackedKeys(
+        cw1=np.ascontiguousarray(slots[:, 1:65]),
+        cw2=np.ascontiguousarray(slots[:, 65:129]),
+        last=np.ascontiguousarray(slots[:, 129]),
+        depth=depth, n=n0)
+
+
 def generate_keys_r4(alpha: int, n: int, seed: bytes, prf_method: int,
                      beta: int = 1):
     """Two servers' mixed-radix keys for f(alpha) = beta (mod 2^128).

@@ -36,6 +36,20 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..core import expand, u128
 from ..core.expand import _level_step  # shared level recurrence
 
+# jax.shard_map graduated from jax.experimental in newer releases;
+# accept both so the mesh path runs on older jaxlibs too
+_shard_map = getattr(jax, "shard_map", None)
+if _shard_map is None:  # pragma: no cover - version dependent
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def _pvary(x, axes):
+    """Type a shard_map scan carry as varying over the mesh axes.  On
+    jaxlibs without varying-types (no ``lax.pvary``) the carry mismatch
+    this guards against does not exist — identity is correct."""
+    fn = getattr(jax.lax, "pvary", None)
+    return fn(x, axes) if fn is not None else x
+
 
 def make_mesh(n_table: int | None = None, n_batch: int = 1,
               devices=None) -> Mesh:
@@ -82,7 +96,7 @@ def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
                                n_total=n, aes_impl=aes_impl)
         return jax.lax.psum(out, "table")
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         per_shard, mesh=mesh,
         in_specs=(P("batch"), P("batch"), P("batch"), P("table", None)),
         out_specs=P("batch", None))
@@ -135,7 +149,7 @@ def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
     acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
     # inside shard_map the scan carry must be typed as varying over the
     # mesh axes (the body's output is), or the carry types mismatch
-    acc0 = jax.lax.pvary(acc0, ("batch", "table"))
+    acc0 = _pvary(acc0, ("batch", "table"))
     acc, _ = jax.lax.scan(body, acc0, (frontier, tbl_chunks))
     return acc
 
@@ -205,11 +219,11 @@ def eval_sharded_mixed(cw1, cw2, last, table_perm, *, n: int,
                                              chunk), None
 
             acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
-            acc0 = jax.lax.pvary(acc0, ("batch", "table"))
+            acc0 = _pvary(acc0, ("batch", "table"))
             out, _ = jax.lax.scan(body, acc0, (frontier, tbl_chunks))
         return jax.lax.psum(out, "table")
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         per_shard, mesh=mesh,
         in_specs=(P("batch"), P("batch"), P("batch"), P("table", None)),
         out_specs=P("batch", None))
@@ -242,39 +256,44 @@ class ShardedDPFServer:
         shard_rows = self.n // self.mesh.shape["table"]
         self.chunk = min(expand.choose_chunk(self.n, batch_size), shard_rows)
 
-    def eval(self, keys) -> np.ndarray:
-        if not keys:
+    def _decode_batch(self, keys):
+        """Vectorized ingest: wire keys -> PackedKeys validated against
+        this server's table (shared with the serving engine)."""
+        if not len(keys):
             raise ValueError("empty key batch")
-        from ..core import prf as _prf
         if self.radix == 4:
             from ..core import radix4
-            mk = [radix4.deserialize_mixed_key(k) for k in keys]
-            for k in mk:
-                if k.n != self.n:
-                    raise ValueError(
-                        "key generated for n=%d but table has n=%d"
-                        % (k.n, self.n))
-            eff = len(mk)
-            pad = (-eff) % max(self.mesh.shape["batch"], 1)
-            mk = mk + [mk[-1]] * pad
-            cw1, cw2, last = radix4.pack_mixed_keys(mk)
-            out = eval_sharded_mixed(
-                cw1, cw2, last, self.table_sharded, n=self.n,
+            pk = radix4.decode_mixed_keys_batched(keys)
+        else:
+            pk = self._keygen.decode_keys_batched(keys)
+        if pk.n != self.n:
+            raise ValueError("key generated for n=%d but table has n=%d"
+                             % (pk.n, self.n))
+        return pk
+
+    def _dispatch_packed(self, pk):
+        """Pad to the mesh "batch" axis and dispatch WITHOUT a host sync
+        (async, for the serving engine's host/device overlap).  The
+        returned device array may carry pad rows — callers trim to the
+        real batch."""
+        from ..core import prf as _prf
+        pk = pk.pad_to(pk.batch
+                       + (-pk.batch) % max(self.mesh.shape["batch"], 1))
+        if self.radix == 4:
+            return eval_sharded_mixed(
+                pk.cw1, pk.cw2, pk.last, self.table_sharded, n=self.n,
                 prf_method=self.prf_method, chunk_leaves=self.chunk,
                 mesh=self.mesh, aes_impl=_prf._aes_pair_impl())
-            return np.asarray(out)[:eff]
-        flat = [self._keygen.deserialize_key(k) for k in keys]
-        for fk in flat:
-            if fk.n != self.n:
-                raise ValueError("key generated for n=%d but table has n=%d"
-                                 % (fk.n, self.n))
-        eff = len(flat)
-        nb = self.mesh.shape["batch"]
-        pad = (-eff) % max(nb, 1)
-        flat = flat + [flat[-1]] * pad
-        cw1, cw2, last = expand.pack_keys(flat)
-        out = eval_sharded(cw1, cw2, last, self.table_sharded,
-                           depth=self.depth, prf_method=self.prf_method,
-                           chunk_leaves=self.chunk, mesh=self.mesh,
-                           aes_impl=_prf._aes_pair_impl())
-        return np.asarray(out)[:eff]
+        return eval_sharded(pk.cw1, pk.cw2, pk.last, self.table_sharded,
+                            depth=self.depth, prf_method=self.prf_method,
+                            chunk_leaves=self.chunk, mesh=self.mesh,
+                            aes_impl=_prf._aes_pair_impl())
+
+    def eval(self, keys) -> np.ndarray:
+        pk = self._decode_batch(keys)
+        return np.asarray(self._dispatch_packed(pk))[:pk.batch]
+
+    def serving_engine(self, **kwargs):
+        """Mesh-path ``ServingEngine`` (serve/engine.py) over this server."""
+        from ..serve import ServingEngine
+        return ServingEngine(self, **kwargs)

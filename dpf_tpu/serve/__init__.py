@@ -1,0 +1,12 @@
+"""Throughput-oriented serving subsystem.
+
+``ServingEngine`` (engine.py) pipelines host packing against device
+execution under a bounded in-flight window; ``Buckets`` (buckets.py)
+bounds the compiled-program count under ragged batch sizes;
+``bench_serve.py`` measures sustained queries/sec for the blocking loop
+vs. the engine.  Constructed via ``DPF.serving_engine()`` or
+``ShardedDPFServer.serving_engine()``.
+"""
+
+from .buckets import Buckets  # noqa: F401
+from .engine import EngineFuture, ServingEngine  # noqa: F401

@@ -1,0 +1,71 @@
+"""Shape-bucketed batching: bound the number of compiled XLA programs.
+
+Every distinct batch size is a distinct jitted program shape; a ragged
+query stream would compile one program per size it happens to produce.
+The engine instead pads each incoming batch up to the smallest member of
+a small fixed set of power-of-two bucket sizes, so at most
+``len(sizes)`` programs ever compile per (table, PRF, kernel) config —
+and all of them can be precompiled at init (``ServingEngine.warmup``).
+
+The tradeoff is pad waste: with the default /2 ladder (64/128/256/512
+for a 512 cap) a batch lands at most 2x above its real size, and the
+pad rows are discarded after the dispatch.  A sparser /4 ladder halves
+the compile count at double the worst-case waste — see docs/SERVING.md.
+"""
+
+from __future__ import annotations
+
+
+class Buckets:
+    """A sorted set of power-of-two batch-shape buckets."""
+
+    def __init__(self, sizes):
+        sizes = sorted({int(s) for s in sizes})
+        if not sizes:
+            raise ValueError("need at least one bucket size")
+        for s in sizes:
+            if s < 1 or (s & (s - 1)) != 0:
+                raise ValueError(
+                    "bucket sizes must be powers of two >= 1 (got %r)"
+                    % (s,))
+        self.sizes = tuple(sizes)
+        self.max = sizes[-1]
+
+    @staticmethod
+    def default_sizes(cap: int, fanout: int = 2, count: int = 4) -> tuple:
+        """A geometric ladder below ``cap``: cap, cap/fanout, ... (pow2;
+        a non-pow2 cap rounds down).  cap=512 -> (64, 128, 256, 512):
+        pad waste < 2x at any size above the smallest bucket.  Pad rows
+        are fully evaluated, so waste is device time — prefer a ladder
+        whose rungs straddle the real batch-size distribution."""
+        s = 1
+        while s * 2 <= max(1, cap):
+            s *= 2
+        out = []
+        while s >= 1 and len(out) < count:
+            out.append(s)
+            s //= fanout
+        return tuple(reversed(out))
+
+    def bucket_for(self, b: int) -> int:
+        """Smallest bucket >= b (b must be in (0, max])."""
+        if b < 1:
+            raise ValueError("batch must be >= 1 (got %d)" % b)
+        for s in self.sizes:
+            if s >= b:
+                return s
+        raise ValueError("batch %d exceeds the largest bucket %d "
+                         "(split with chunks())" % (b, self.max))
+
+    def chunks(self, b: int) -> list:
+        """Split a batch of ``b`` keys into (lo, hi) spans, each at most
+        one max bucket wide: full max-sized spans then one remainder."""
+        if b < 1:
+            raise ValueError("batch must be >= 1 (got %d)" % b)
+        spans = []
+        lo = 0
+        while b - lo > self.max:
+            spans.append((lo, lo + self.max))
+            lo += self.max
+        spans.append((lo, b))
+        return spans

@@ -1,0 +1,255 @@
+"""Pipelined serving engine: keep the device saturated under a stream of
+query batches.
+
+The blocking loop (``DPF.eval_tpu`` per batch) serializes host and
+device: deserialize keys, pack, dispatch, then ``np.asarray`` — the
+device idles while the host parses the next batch (the host/device
+overlap problem of the TPU linear-algebra literature, PAPERS.md
+arXiv:2112.09017).  The engine splits that pipeline:
+
+* **Vectorized ingest** — a whole batch decodes through the batched wire
+  codec (``keygen.decode_keys_batched`` / ``radix4``'s counterpart) in
+  O(1) Python ops instead of a per-key loop.
+* **Double-buffered dispatch** — ``submit()`` returns a future
+  immediately after enqueueing the jitted program (JAX async dispatch,
+  no premature ``np.asarray``); the host packs batch k+1 while batch k
+  runs on device.  A configurable ``max_in_flight`` window bounds the
+  queue: when full, ``submit`` blocks on the oldest outstanding dispatch
+  (backpressure) before enqueueing more.
+* **Shape-bucketed batching** — ragged batch sizes pad up to a small
+  fixed set of power-of-two buckets (``serve/buckets.py``) so at most
+  ``len(buckets)`` XLA programs compile; ``warmup()`` precompiles all of
+  them at init.
+
+The engine is server-agnostic: any object with ``_decode_batch(keys) ->
+PackedKeys`` and ``_dispatch_packed(pk) -> device array`` works — both
+``api.DPF`` (single chip) and ``parallel.sharded.ShardedDPFServer``
+(mesh path) provide the pair.  Results are bit-identical to the blocking
+loop (pad rows are discarded; per-key math is batch-shape independent).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+import numpy as np
+
+from ..core.expand import DeadlineExceeded
+from ..utils.profiling import EngineCounters
+from .buckets import Buckets
+
+
+class _Part:
+    """One dispatched (bucket-padded) chunk of a submitted batch."""
+    __slots__ = ("dev", "n_real", "out")
+
+    def __init__(self, dev, n_real):
+        self.dev = dev          # device array, possibly still in flight
+        self.n_real = n_real    # rows that are real queries (not pad)
+        self.out = None         # resolved host array
+
+
+class EngineFuture:
+    """Result handle for one submitted batch.
+
+    ``result()`` blocks until this batch — and, FIFO, every batch
+    submitted before it — has left the device, then returns the
+    ``[batch, entry_size]`` int32 share array.
+    """
+    __slots__ = ("_engine", "_parts", "_value")
+
+    def __init__(self, engine):
+        self._engine = engine
+        self._parts = []
+        self._value = None
+
+    def done(self) -> bool:
+        return self._value is not None
+
+    def result(self):
+        if self._value is None:
+            self._engine._resolve_through(self)
+        return self._value
+
+
+class ServingEngine:
+    """Throughput-oriented DPF serving over one prepared table.
+
+    Args:
+      server: an ``api.DPF`` after ``eval_init`` or a
+        ``parallel.sharded.ShardedDPFServer``.
+      max_in_flight: dispatch-window size (outstanding device programs
+        before ``submit`` applies backpressure).  2 is classic double
+        buffering.
+      buckets: a ``Buckets``, an iterable of power-of-two sizes, or None
+        for the default /2 ladder under the server's batch cap.  On the
+        mesh path, sizes should be multiples of the mesh "batch" axis or
+        the dispatch pads further (still one program per bucket).
+      warmup: precompile every bucket at construction.
+
+    ``deadline`` (a ``time.time()`` value) is checked cooperatively
+    between dispatches and resolutions — never mid-compile (relay
+    safety, docs/STATUS.md) — raising ``expand.DeadlineExceeded``.
+    """
+
+    def __init__(self, server, *, max_in_flight: int = 2, buckets=None,
+                 warmup: bool = False, deadline: float | None = None):
+        if max_in_flight < 1:
+            raise ValueError("max_in_flight must be >= 1 (got %d)"
+                             % max_in_flight)
+        if getattr(server, "scheme", "logn") == "sqrtn":
+            raise NotImplementedError(
+                "ServingEngine supports the logn schemes (binary and "
+                "radix-4); sqrtn keys have no packed-batch codec yet")
+        n = getattr(server, "table_num_entries", None)
+        if n is None:
+            n = getattr(server, "n", None)
+        if n is None:
+            raise RuntimeError(
+                "server has no initialized table — call eval_init first")
+        self._server = server
+        self._n = int(n)
+        self._out_width = getattr(server, "table_effective_entry_size",
+                                  None) or getattr(server, "entry_size")
+        self.max_in_flight = int(max_in_flight)
+        if not isinstance(buckets, Buckets):
+            cap = (getattr(server, "BATCH_SIZE", None)
+                   or getattr(server, "batch_size", 512))
+            buckets = Buckets(buckets if buckets is not None
+                              else Buckets.default_sizes(cap))
+        self.buckets = buckets
+        self.deadline = deadline
+        self.stats = EngineCounters()
+        self._queue = deque()     # _Part refs, dispatch order, unresolved
+        self._pending = deque()   # futures with unresolved parts, FIFO
+
+    # ------------------------------------------------------------- submit
+
+    def submit(self, keys) -> EngineFuture:
+        """Decode + dispatch one batch; returns a future immediately.
+
+        The host-side work here is the vectorized decode and the bucket
+        pad; the device program is enqueued asynchronously.  When the
+        in-flight window is full, blocks on the oldest outstanding
+        dispatch first (backpressure).
+        """
+        self._check_deadline()
+        t0 = time.perf_counter()
+        pk = self._server._decode_batch(keys)
+        b = pk.batch
+        fut = EngineFuture(self)
+        try:
+            for lo, hi in self.buckets.chunks(b):
+                self._check_deadline()
+                size = self.buckets.bucket_for(hi - lo)
+                padded = pk.slice(lo, hi).pad_to(size)
+                self.stats.pack_time_s += time.perf_counter() - t0
+                while len(self._queue) >= self.max_in_flight:
+                    self._check_deadline()
+                    self._resolve_one()
+                t1 = time.perf_counter()
+                dev = self._server._dispatch_packed(padded)
+                self.stats.dispatch_time_s += time.perf_counter() - t1
+                part = _Part(dev, hi - lo)
+                fut._parts.append(part)
+                self._queue.append(part)
+                self.stats.note_dispatch(padded=size - (hi - lo),
+                                         in_flight=len(self._queue))
+                t0 = time.perf_counter()
+        except BaseException:
+            # Unwind a partially submitted batch: its dispatched parts
+            # must not stay orphaned in the window (the future is never
+            # returned), so block on each (never interrupt an in-flight
+            # program — relay safety) and drop it from the queue.
+            for p in fut._parts:
+                try:
+                    self._queue.remove(p)
+                except ValueError:
+                    pass
+                if p.dev is not None:
+                    np.asarray(p.dev)
+                    p.dev = None
+            raise
+        self.stats.batches_submitted += 1
+        self.stats.queries_submitted += b
+        self._pending.append(fut)
+        return fut
+
+    # ---------------------------------------------------------- resolution
+
+    def _resolve_one(self):
+        """Block on the oldest in-flight dispatch and store its rows."""
+        part = self._queue.popleft()
+        t0 = time.perf_counter()
+        part.out = np.asarray(part.dev)[:part.n_real]
+        self.stats.wait_time_s += time.perf_counter() - t0
+        part.dev = None
+
+    def _finalize(self, fut: EngineFuture):
+        parts = fut._parts
+        if len(parts) == 1:
+            out = parts[0].out
+        else:
+            out = np.concatenate([p.out for p in parts])
+        fut._value = np.ascontiguousarray(out[:, :self._out_width])
+        fut._parts = []
+
+    def _resolve_through(self, fut: EngineFuture):
+        """Resolve futures FIFO until (and including) ``fut``."""
+        while self._pending:
+            head = self._pending.popleft()
+            while any(p.out is None for p in head._parts):
+                self._resolve_one()
+            self._finalize(head)
+            if head is fut:
+                return
+        if fut._value is None:  # not one of ours
+            raise RuntimeError("future does not belong to this engine")
+
+    def drain(self) -> None:
+        """Resolve every outstanding dispatch (blocks until the device is
+        idle); all previously returned futures become ``done()``."""
+        while self._pending:
+            self._check_deadline()
+            head = self._pending.popleft()
+            while any(p.out is None for p in head._parts):
+                self._resolve_one()
+            self._finalize(head)
+
+    # ------------------------------------------------------------- warmup
+
+    def warmup(self) -> None:
+        """Precompile every bucket's program with synthetic keys.
+
+        A zero-codeword key with a valid header (depth/n) decodes into
+        the exact array shapes real traffic produces, so each dispatch
+        here populates the jit cache for one bucket size; outputs are
+        discarded and none of the serving counters move.
+        """
+        from ..core.keygen import PackedKeys
+        depth = self._n.bit_length() - 1
+        for size in self.buckets.sizes:
+            pk = PackedKeys(
+                cw1=np.zeros((size, 64, 4), dtype=np.uint32),
+                cw2=np.zeros((size, 64, 4), dtype=np.uint32),
+                last=np.zeros((size, 4), dtype=np.uint32),
+                depth=depth, n=self._n)
+            np.asarray(self._server._dispatch_packed(pk))
+
+    # ------------------------------------------------------------ plumbing
+
+    def _check_deadline(self):
+        if self.deadline is not None and time.time() > self.deadline:
+            raise DeadlineExceeded(
+                "serving-engine deadline passed between dispatches")
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._queue)
+
+    def __repr__(self):
+        return ("ServingEngine(n=%d, buckets=%s, max_in_flight=%d, "
+                "served=%d)" % (self._n, list(self.buckets.sizes),
+                                self.max_in_flight,
+                                self.stats.queries_submitted))

@@ -8,6 +8,7 @@ trace directory naming mirrors the reference's artifact-per-config scheme
 from __future__ import annotations
 
 import contextlib
+import dataclasses
 import os
 import time
 
@@ -107,6 +108,44 @@ def summarize_trace(trace_dir: str, top: int = 12):
             "device_ms": round(total_us / 1e3, 3),
             "top_ops": [{"op": k, "ms": round(v / 1e3, 3)}
                         for k, v in ops]}
+
+
+@dataclasses.dataclass
+class EngineCounters:
+    """Per-engine serving counters (serve/engine.py).
+
+    Host pack time (vectorized decode + bucket pad), dispatch time (the
+    jitted call — async enqueue on TPU, the compute itself on the
+    synchronous CPU backend) and wait time (host blocking on device
+    results) are split so the host/device overlap the engine buys is
+    visible in the benchmark record.
+    """
+    batches_submitted: int = 0
+    queries_submitted: int = 0
+    dispatches: int = 0
+    padded_queries: int = 0       # pad rows dispatched (bucket waste)
+    in_flight_hwm: int = 0        # high-water mark of the dispatch window
+    pack_time_s: float = 0.0
+    dispatch_time_s: float = 0.0
+    wait_time_s: float = 0.0
+
+    def note_dispatch(self, padded: int, in_flight: int):
+        self.dispatches += 1
+        self.padded_queries += padded
+        self.in_flight_hwm = max(self.in_flight_hwm, in_flight)
+
+    @property
+    def pad_waste(self) -> float:
+        """Fraction of dispatched query slots that were padding."""
+        total = self.queries_submitted + self.padded_queries
+        return self.padded_queries / total if total else 0.0
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        for k in ("pack_time_s", "dispatch_time_s", "wait_time_s"):
+            d[k] = round(d[k], 6)
+        d["pad_waste"] = round(self.pad_waste, 4)
+        return d
 
 
 class Timer:

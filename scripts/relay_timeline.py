@@ -33,24 +33,42 @@ def summarize(path):
             unavailable += 1
     if not attempts:
         return "relay timeline: no attempts logged in %s" % path
-    # cadence from consecutive same-day timestamps (restarts reset N)
+
     def secs(hms):
         h, m, s = map(int, hms.split(":"))
         return 3600 * h + 60 * m + s
-    gaps = []
-    for (_, a), (_, b) in zip(attempts, attempts[1:]):
-        d = secs(b) - secs(a)
-        if 0 < d < 3 * 3600:
-            gaps.append(d)
+
+    # The log carries HH:MM:SS only; a timestamp running backwards means
+    # a midnight was crossed.  Carry a rolling day offset so (a) the
+    # first/last stamps are date-qualified over multi-day logs and (b)
+    # cross-midnight gaps stay IN the cadence median instead of being
+    # silently dropped as negative.  Gaps hiding 2+ whole days still
+    # collapse to one — the day count is a lower bound, and is labeled so.
+    stamps = []  # seconds since day 0, day offset folded in
+    day = 0
+    prev = None
+    for _, hms in attempts:
+        s = secs(hms)
+        if prev is not None and s < prev:
+            day += 1
+        stamps.append(day * 86400 + s)
+        prev = s
+    gaps = [b - a for a, b in zip(stamps, stamps[1:]) if 0 < b - a < 3 * 3600]
     med = sorted(gaps)[len(gaps) // 2] if gaps else None
     cadence = ("median cadence %dm%02ds" % (med // 60, med % 60)
                if med is not None else "cadence n/a (<2 attempts)")
     other = max(0, len(attempts) - unavailable)
+    if day:
+        first = "%s (day 0)" % attempts[0][1]
+        last = "%s (day %d)" % (attempts[-1][1], day)
+        utc = "UTC, spanning >=%d days" % (day + 1)
+    else:
+        first, last, utc = attempts[0][1], attempts[-1][1], "UTC"
     return ("relay timeline (%s): %d claimant attempts, first %s, last "
-            "%s (UTC), %s; outcomes: %d terminal UNAVAILABLE, %d "
+            "%s (%s), %s; outcomes: %d terminal UNAVAILABLE, %d "
             "other/in-flight — every attempt was a lone claimant "
             "(flock-guarded single loop)"
-            % (path, len(attempts), attempts[0][1], attempts[-1][1],
+            % (path, len(attempts), first, last, utc,
                cadence, unavailable, other))
 
 

@@ -29,8 +29,11 @@ elif [ -z "${KEEPALIVE_LOCK_FD:-}" ]; then
   # on one mechanism (advisor finding, round 4).  flock_exec.py exits 1
   # if another claimant holds it; otherwise it execs us with the locked
   # fd inherited (KEEPALIVE_LOCK_FD set) for this process's lifetime.
-  # absolute path: the cd at the top already moved us off $0's base dir
-  exec python scripts/flock_exec.py "$LOCK_FILE" /bin/sh \
+  # absolute path: the cd at the top already moved us off $0's base dir.
+  # resolve python3 before bare python: hosts without a `python` alias
+  # must not lose the keepalive loop to a 127 here (ADVICE.md round 5)
+  PY=$(command -v python3 || command -v python)
+  exec "$PY" scripts/flock_exec.py "$LOCK_FILE" /bin/sh \
     "$PWD/scripts/tpu_keepalive.sh" "$@"
 fi
 

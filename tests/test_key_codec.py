@@ -1,0 +1,118 @@
+"""Batched wire-codec equivalence: the vectorized ingest
+(``keygen.decode_keys_batched`` / ``radix4.decode_mixed_keys_batched``)
+must be bit-identical to the scalar codec (``deserialize_key`` +
+``pack_keys``), which stays as the oracle — binary and radix-4 wire
+formats, fuzzed over (n, alpha, seed)."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.core import expand, keygen, radix4
+
+
+def _binary_batch(n, batch, seed=0):
+    rng = np.random.default_rng(seed)
+    keys = []
+    for i in range(batch):
+        k0, k1 = keygen.generate_keys(int(rng.integers(0, n)), n,
+                                      b"codec-%d-%d" % (seed, i),
+                                      prf_method=0)
+        keys.append((k0 if i % 2 else k1).serialize())
+    return keys
+
+
+def _mixed_batch(n, batch, seed=0):
+    rng = np.random.default_rng(seed)
+    keys = []
+    for i in range(batch):
+        k0, k1 = radix4.generate_keys_r4(int(rng.integers(0, n)), n,
+                                         b"codec4-%d-%d" % (seed, i),
+                                         prf_method=0)
+        keys.append((k0 if i % 2 else k1).serialize())
+    return keys
+
+
+@pytest.mark.parametrize("n", [2, 8, 256, 4096])
+@pytest.mark.parametrize("batch", [1, 3, 17])
+def test_binary_batched_equals_scalar(n, batch):
+    keys = _binary_batch(n, batch, seed=n + batch)
+    flat = [keygen.deserialize_key(k) for k in keys]
+    cw1, cw2, last = expand.pack_keys(flat)
+    pk = keygen.decode_keys_batched(keys)
+    assert np.array_equal(pk.cw1, cw1)
+    assert np.array_equal(pk.cw2, cw2)
+    assert np.array_equal(pk.last, last)
+    assert pk.n == flat[0].n and pk.depth == flat[0].depth
+    assert pk.cw1.dtype == np.uint32 and pk.last.dtype == np.uint32
+
+
+@pytest.mark.parametrize("n", [4, 16, 1024, 4096])
+@pytest.mark.parametrize("batch", [1, 5, 16])
+def test_mixed_batched_equals_scalar(n, batch):
+    keys = _mixed_batch(n, batch, seed=n + batch)
+    mk = [radix4.deserialize_mixed_key(k) for k in keys]
+    cw1, cw2, last = radix4.pack_mixed_keys(mk)
+    pk = radix4.decode_mixed_keys_batched(keys)
+    assert np.array_equal(pk.cw1, cw1)
+    assert np.array_equal(pk.cw2, cw2)
+    assert np.array_equal(pk.last, last)
+    assert pk.n == mk[0].n
+
+
+def test_binary_fuzz_roundtrip():
+    """Fuzzed serialize -> batched decode -> re-serialize bit-exactness."""
+    rng = np.random.default_rng(7)
+    for trial in range(8):
+        n = int(2 ** rng.integers(1, 13))
+        keys = _binary_batch(n, int(rng.integers(1, 9)), seed=trial)
+        pk = keygen.decode_keys_batched(keys)
+        for i, wire in enumerate(keys):
+            fk = keygen.FlatKey(depth=pk.depth, cw1=pk.cw1[i],
+                                cw2=pk.cw2[i],
+                                last_key=int(keygen.u128.limbs_to_int(
+                                    pk.last[i])), n=pk.n)
+            assert np.array_equal(fk.serialize(), np.asarray(wire))
+
+
+def test_stacked_2d_array_input():
+    """A pre-stacked [B, 524] buffer skips the per-key stack loop."""
+    keys = _binary_batch(512, 4)
+    stacked = np.stack([np.asarray(k) for k in keys])
+    pk = keygen.decode_keys_batched(stacked)
+    ref = keygen.decode_keys_batched(keys)
+    assert np.array_equal(pk.cw1, ref.cw1)
+    assert np.array_equal(pk.last, ref.last)
+
+
+def test_mixed_table_sizes_rejected():
+    keys = _binary_batch(256, 2) + _binary_batch(512, 1)
+    with pytest.raises(ValueError, match="mixed table sizes"):
+        keygen.decode_keys_batched(keys)
+
+
+def test_radix_marker_cross_rejection():
+    bin_keys = _binary_batch(256, 2)
+    mix_keys = _mixed_batch(256, 2)
+    with pytest.raises(ValueError, match="mixed-radix"):
+        keygen.decode_keys_batched(mix_keys)
+    with pytest.raises(ValueError, match="not a mixed-radix key"):
+        radix4.decode_mixed_keys_batched(bin_keys)
+
+
+def test_wrong_word_count_rejected():
+    with pytest.raises(ValueError, match="524 int32 words"):
+        keygen.decode_keys_batched([np.zeros(100, np.int32)])
+    with pytest.raises(ValueError, match="empty key batch"):
+        keygen.decode_keys_batched([])
+
+
+def test_pad_to_repeats_last_key():
+    keys = _binary_batch(256, 3)
+    pk = keygen.decode_keys_batched(keys)
+    padded = pk.pad_to(8)
+    assert padded.batch == 8
+    assert np.array_equal(padded.cw1[:3], pk.cw1)
+    for i in range(3, 8):
+        assert np.array_equal(padded.cw1[i], pk.cw1[-1])
+        assert np.array_equal(padded.last[i], pk.last[-1])
+    assert padded.pad_to(4) is padded  # no-op when already larger

@@ -1,0 +1,252 @@
+"""Serving-engine tests: bucket math, engine-vs-blocking-loop equality
+(binary, radix-4, mesh-sharded), backpressure window accounting, the
+cooperative deadline, and warmup precompile."""
+
+import time
+
+import numpy as np
+import pytest
+
+from dpf_tpu import DPF
+from dpf_tpu.core.expand import DeadlineExceeded
+from dpf_tpu.serve import Buckets, ServingEngine
+from dpf_tpu.utils.config import EvalConfig
+
+
+def _setup(n=256, entry=7, prf=DPF.PRF_DUMMY, config=None):
+    dpf = DPF(prf=prf, config=config)
+    table = np.random.default_rng(5).integers(
+        -2 ** 31, 2 ** 31, (n, entry), dtype=np.int64).astype(np.int32)
+    dpf.eval_init(table)
+    keys = [dpf.gen((i * 97) % n, n, seed=b"serve-%d" % i)[0]
+            for i in range(20)]
+    return dpf, keys
+
+
+def _batches(keys, sizes):
+    out = []
+    j = 0
+    for b in sizes:
+        out.append([keys[(j + i) % len(keys)] for i in range(b)])
+        j += 1
+    return out
+
+
+# --------------------------------------------------------------- buckets
+
+def test_bucket_validation_and_lookup():
+    bk = Buckets((16, 4))
+    assert bk.sizes == (4, 16) and bk.max == 16
+    assert bk.bucket_for(1) == 4
+    assert bk.bucket_for(4) == 4
+    assert bk.bucket_for(5) == 16
+    assert bk.bucket_for(16) == 16
+    with pytest.raises(ValueError):
+        bk.bucket_for(17)
+    with pytest.raises(ValueError):
+        bk.bucket_for(0)
+    with pytest.raises(ValueError):
+        Buckets((3,))
+    with pytest.raises(ValueError):
+        Buckets(())
+
+
+def test_bucket_chunks():
+    bk = Buckets((4, 16))
+    assert bk.chunks(1) == [(0, 1)]
+    assert bk.chunks(16) == [(0, 16)]
+    assert bk.chunks(40) == [(0, 16), (16, 32), (32, 40)]
+    assert bk.chunks(32) == [(0, 16), (16, 32)]
+
+
+def test_default_sizes_ladder():
+    assert Buckets.default_sizes(512) == (64, 128, 256, 512)
+    assert Buckets.default_sizes(512, fanout=4) == (8, 32, 128, 512)
+    assert Buckets.default_sizes(8) == (1, 2, 4, 8)
+    assert Buckets.default_sizes(500) == (32, 64, 128, 256)  # pow2 floor
+
+
+# ---------------------------------------------------- engine == blocking
+
+def test_engine_matches_blocking_loop_ragged():
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4, 16), max_in_flight=2)
+    sizes = [1, 3, 16, 7, 4, 12, 16, 2]  # includes B=1 and B=bucket_max
+    stream = _batches(keys, sizes)
+    futs = [engine.submit(b) for b in stream]
+    engine.drain()
+    for b, fut in zip(stream, futs):
+        ref = np.asarray(dpf.eval_tpu(b))
+        assert np.array_equal(fut.result(), ref)
+        assert fut.done()
+    assert engine.stats.batches_submitted == len(sizes)
+    assert engine.stats.queries_submitted == sum(sizes)
+
+
+def test_engine_multi_chunk_batch():
+    """A batch larger than the max bucket splits into max-sized spans."""
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4, 8))
+    batch = [keys[i % len(keys)] for i in range(21)]  # 8 + 8 + 5->8
+    fut = engine.submit(batch)
+    out = fut.result()
+    assert out.shape == (21, 7)
+    assert np.array_equal(out, np.asarray(dpf.eval_tpu(batch)))
+    assert engine.stats.dispatches == 3
+    assert engine.stats.padded_queries == 3  # only the remainder pads
+
+
+def test_engine_radix4_matches_blocking():
+    cfg = EvalConfig(prf_method=DPF.PRF_DUMMY, radix=4)
+    dpf, keys = _setup(config=cfg)
+    engine = dpf.serving_engine(buckets=(8,))
+    stream = _batches(keys, [8, 3, 1])
+    futs = [engine.submit(b) for b in stream]
+    for b, fut in zip(stream, futs):
+        assert np.array_equal(fut.result(), np.asarray(dpf.eval_tpu(b)))
+
+
+def test_engine_share_recovery_end_to_end():
+    """Two engines (one per server) recover the exact table rows."""
+    n, entry = 256, 5
+    dpf = DPF(prf=DPF.PRF_SALSA20)
+    table = np.random.default_rng(9).integers(
+        0, 2 ** 31, (n, entry), dtype=np.int64).astype(np.int32)
+    dpf.eval_init(table)
+    idxs = [7, 0, 255, 100]
+    pairs = [dpf.gen(i, n) for i in idxs]
+    engine = dpf.serving_engine(buckets=(4,))
+    f0 = engine.submit([p[0] for p in pairs])
+    f1 = engine.submit([p[1] for p in pairs])
+    rec = (f0.result() - f1.result()).astype(np.int32)
+    assert (rec == table[idxs]).all()
+
+
+# -------------------------------------------------- window + backpressure
+
+def test_max_in_flight_window_bounds_queue():
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,), max_in_flight=2)
+    stream = _batches(keys, [4, 4, 4, 4, 4, 4])
+    futs = [engine.submit(b) for b in stream]
+    assert engine.in_flight <= 2
+    assert engine.stats.in_flight_hwm <= 2
+    engine.drain()
+    assert engine.in_flight == 0
+    for b, fut in zip(stream, futs):
+        assert np.array_equal(fut.result(), np.asarray(dpf.eval_tpu(b)))
+
+
+def test_backpressure_resolves_oldest_first():
+    """With a window of 1, every submit forces the previous dispatch to
+    resolve: earlier futures become done before later ones."""
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,), max_in_flight=1)
+    f1 = engine.submit(_batches(keys, [4])[0])
+    f2 = engine.submit(_batches(keys, [4])[0])
+    # f1's part must have left the window to admit f2's dispatch
+    assert engine.in_flight == 1
+    assert engine.stats.in_flight_hwm == 1
+    r2 = f2.result()
+    assert f1.done()  # FIFO resolution covered f1 on the way to f2
+    assert r2 is not None
+
+
+def test_failed_mid_submit_leaves_engine_consistent():
+    """An exception between the chunks of a multi-chunk submit must not
+    orphan already-dispatched parts in the window: the engine unwinds
+    them and stays usable."""
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,), max_in_flight=8)
+    real_dispatch = dpf._dispatch_packed
+    calls = {"n": 0}
+
+    def flaky(pk):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected dispatch failure")
+        return real_dispatch(pk)
+
+    dpf._dispatch_packed = flaky
+    try:
+        with pytest.raises(RuntimeError, match="injected"):
+            engine.submit([keys[i % len(keys)] for i in range(8)])  # 2 chunks
+    finally:
+        dpf._dispatch_packed = real_dispatch
+    assert engine.in_flight == 0
+    assert engine.stats.batches_submitted == 0
+    batch = _batches(keys, [4])[0]
+    fut = engine.submit(batch)
+    assert np.array_equal(fut.result(), np.asarray(dpf.eval_tpu(batch)))
+
+
+def test_engine_deadline_is_cooperative():
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,))
+    engine.deadline = time.time() - 1
+    with pytest.raises(DeadlineExceeded):
+        engine.submit(_batches(keys, [4])[0])
+    engine.deadline = None
+    fut = engine.submit(_batches(keys, [4])[0])
+    assert fut.result().shape == (4, 7)
+
+
+# --------------------------------------------------------- stats + warmup
+
+def test_pad_waste_accounting():
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4, 16))
+    engine.submit(_batches(keys, [1])[0]).result()
+    assert engine.stats.padded_queries == 3
+    assert engine.stats.pad_waste == pytest.approx(0.75)
+    engine.submit(_batches(keys, [16])[0]).result()
+    assert engine.stats.padded_queries == 3  # exact bucket: no new pad
+    assert engine.stats.pad_waste == pytest.approx(3 / 20)
+
+
+def test_warmup_precompiles_without_serving():
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4, 8), warmup=True)
+    assert engine.stats.batches_submitted == 0
+    assert engine.stats.dispatches == 0
+    fut = engine.submit(_batches(keys, [5])[0])
+    assert fut.result().shape == (5, 7)
+
+
+def test_engine_requires_initialized_table():
+    with pytest.raises(RuntimeError, match="eval_init"):
+        ServingEngine(DPF(prf=DPF.PRF_DUMMY))
+
+
+def test_engine_rejects_sqrtn():
+    dpf = DPF(config=EvalConfig(prf_method=0, scheme="sqrtn"))
+    with pytest.raises(NotImplementedError):
+        ServingEngine(dpf)
+
+
+# ---------------------------------------------------------- sharded path
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return jax.devices()
+
+
+def test_engine_over_sharded_server(eight_devices):
+    from dpf_tpu.parallel import sharded
+    n, entry, batch = 2048, 5, 8
+    dpf = DPF(prf=DPF.PRF_DUMMY)
+    table = np.random.default_rng(11).integers(
+        -2 ** 31, 2 ** 31, (n, entry), dtype=np.int64).astype(np.int32)
+    keys = [dpf.gen((i * 997) % n, n)[0] for i in range(12)]
+    mesh = sharded.make_mesh(n_table=4, n_batch=2)
+    srv = sharded.ShardedDPFServer(table, mesh, prf_method=DPF.PRF_DUMMY,
+                                   batch_size=batch)
+    engine = srv.serving_engine(buckets=(4, 8), max_in_flight=2)
+    stream = [keys[:8], keys[8:11], keys[3:4]]  # incl. mesh-pad ragged
+    futs = [engine.submit(b) for b in stream]
+    engine.drain()
+    for b, fut in zip(stream, futs):
+        assert np.array_equal(fut.result(), srv.eval(b))

@@ -1,6 +1,8 @@
 """Mesh-sharded evaluation tests on the virtual 8-device CPU mesh
 (the TPU answer to "multi-node without a cluster", SURVEY.md §4)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -95,6 +97,11 @@ def test_sharded_large_table_smoke(eight_devices):
     assert (rec == table[idxs]).all()
 
 
+@pytest.mark.skipif(
+    not os.environ.get("DPF_RUN_SLOW"),
+    reason="~100 s of 1-core XLA-CPU work; the scan/shard legs are "
+           "pinned by the smaller mesh tests above — this largest-N "
+           "rehearsal runs in the DPF_RUN_SLOW lane")
 def test_sharded_multi_million_rows_functional(eight_devices):
     """Largest-N functional run the CPU mesh comfortably allows
     (VERDICT r2 #4): 2^21 rows x 16 cols (128 MiB) row-sharded over all
