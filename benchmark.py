@@ -3,13 +3,52 @@
 #
 # benchmark.py --serve runs the streaming serving benchmark instead
 # (blocking loop vs pipelined ServingEngine, dpf_tpu/serve/bench_serve.py).
+#
+# benchmark.py --autotune runs the hardware-aware autotuner
+# (dpf_tpu/tune/): staged coordinate descent over the fused-eval knobs
+# per (N, B) point plus a serving-knob grid search, every timed
+# candidate equality-gated against the scalar oracle; winners persist
+# in the tuning cache and the sweep record is written with --out
+# (committed as BENCH_TUNE_r07.json).  See docs/TUNING.md.
 
 import sys
 
 import dpf_tpu
 from dpf_tpu.utils.bench import test_dpf_perf
 
+
+def _autotune_main(argv):
+    import argparse
+
+    from dpf_tpu.tune.search import DEFAULT_SWEEP, autotune_sweep
+
+    ap = argparse.ArgumentParser(
+        description="hardware-aware autotune sweep (docs/TUNING.md)")
+    ap.add_argument("--shapes", default=None,
+                    help="comma list of N:B points (default %s)"
+                         % ",".join("%d:%d" % s for s in DEFAULT_SWEEP))
+    ap.add_argument("--prf", type=int, default=0,
+                    help="PRF id (default 0=DUMMY; 2=ChaCha20, 3=AES128)")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--force", action="store_true",
+                    help="re-measure even with a warm tuning cache")
+    ap.add_argument("--no-serve", action="store_true",
+                    help="skip the serving-knob grid search")
+    ap.add_argument("--out", help="also write the JSON record to a file")
+    args = ap.parse_args(argv)
+    shapes = DEFAULT_SWEEP
+    if args.shapes:
+        shapes = tuple(tuple(int(x) for x in p.split(":"))
+                       for p in args.shapes.split(","))
+    autotune_sweep(shapes, prf_method=args.prf, reps=args.reps,
+                   serve=not args.no_serve, force=args.force,
+                   out=args.out)
+
+
 if __name__ == "__main__":
+    if "--autotune" in sys.argv:
+        _autotune_main([a for a in sys.argv[1:] if a != "--autotune"])
+        sys.exit(0)
     if "--serve" in sys.argv:
         from dpf_tpu.serve.bench_serve import main
         main([a for a in sys.argv[1:] if a != "--serve"])

@@ -113,6 +113,7 @@ class DPF(object):
         self.prf_method = self.DEFAULT_PRF if prf is None else prf
         self.prf_method_string = PRF_NAMES[self.prf_method]
         self.strict = strict          # enforce reference shape limits
+        self._tuned_cache = {}        # batch -> tuning-cache knob dict
         self.table = None             # original table (numpy int32)
         self.table_device = None      # permuted table on device (jnp)
         self.table_num_entries = None
@@ -202,6 +203,7 @@ class DPF(object):
         self.table = tbl
         self.table_num_entries = n
         self.table_effective_entry_size = e
+        self._tuned_cache = {}  # shape changed — re-resolve per batch
         if self.scheme == "sqrtn":
             # the sqrt-N grid emits natural order — no permutation
             self.table_device = jnp.asarray(tbl)
@@ -347,8 +349,11 @@ class DPF(object):
             if k.n != n:
                 raise ValueError(
                     "key generated for n=%d but table has n=%d" % (k.n, n))
+        from .utils.config import is_auto
         seeds, cw1, cw2 = sqrtn.pack_sqrt_keys(sk)
-        dot_impl = (self._config.dot_impl if self._config
+        dot_impl = (self._config.dot_impl
+                    if self._config is not None and
+                    not is_auto(self._config.dot_impl)
                     else matmul128.default_impl())
         out = sqrtn.eval_contract_batched(
             seeds, cw1, cw2, self.table_device,
@@ -377,6 +382,84 @@ class DPF(object):
                 "key generated for n=%d but table has n=%d" % (pk.n, n))
         return pk
 
+    def resolved_eval_knobs(self, batch: int) -> dict:
+        """Concrete program knobs for one dispatch batch size.
+
+        Per-knob precedence: an explicit ``EvalConfig`` field wins; a
+        field left at its auto state (``None``/``"auto"``) takes the
+        tuned value from the persistent tuning cache
+        (``tune/cache.py`` — keyed by device fingerprint x
+        (N, E, B, prf, scheme, radix), nearest-batch fallback, populated
+        by ``benchmark.py --autotune``); static heuristics
+        (``expand.choose_chunk`` et al.) fill the rest.  The tuning
+        lookup is cached per batch size (invalidated by ``eval_init``),
+        but the process-global fallbacks (``matmul128.default_impl``,
+        the AES pair impl, ``ROUND_UNROLL``) are re-read every call so
+        ``set_dot_impl``/``apply_globals`` stay live between dispatches.
+        """
+        from .core import prf as _prf
+        from .ops import matmul128
+        from .utils.config import is_auto
+        cfg = self._config
+        n = self.table_num_entries
+        if n is None:
+            raise RuntimeError("Must call `eval_init` before resolving")
+        tuned = self._tuned_cache.get(batch)
+        if tuned is None:
+            if cfg is None or any(is_auto(v) for v in (
+                    cfg.chunk_leaves, cfg.dot_impl, cfg.kernel_impl,
+                    cfg.aes_impl, cfg.dispatch_group)):
+                from .tune.cache import lookup_eval_knobs
+                tuned = lookup_eval_knobs(
+                    n=n, entry_size=self.table_effective_entry_size,
+                    batch=batch, prf_method=self.prf_method,
+                    scheme=self.scheme, radix=self.radix) or {}
+            else:
+                tuned = {}
+            self._tuned_cache[batch] = tuned
+
+        def pick(field, fallback):
+            explicit = getattr(cfg, field) if cfg is not None else None
+            if not is_auto(explicit):
+                return explicit
+            v = tuned.get(field)
+            return v if v is not None else fallback
+
+        kernel_impl = pick("kernel_impl", "xla")
+        if cfg is not None and cfg.chunk_leaves:
+            chunk = min(cfg.chunk_leaves, n)
+        elif (tuned.get("chunk_leaves")
+                and tuned.get("kernel_impl", kernel_impl) == kernel_impl):
+            # the tuner gated (chunk, kernel) together — a tuned chunk
+            # rides only with ITS kernel (an explicit kernel_impl that
+            # differs, e.g. pallas with its VMEM-bounded tile chunk,
+            # falls through to that kernel's own heuristic) and is
+            # re-checked against the live-seed budget (nearest-batch
+            # fallback can pair a small-batch chunk with a bigger batch)
+            chunk = expand.clamp_chunk(tuned["chunk_leaves"], n, batch)
+        elif (kernel_impl == "pallas" and self.radix == 2
+                and self.prf_method != PRF_AES128):
+            # subtree-kernel chunk is bounded by per-tile VMEM state;
+            # the AES plane-level kernel uses the standard memory bound
+            from .ops.pallas_level import pallas_chunk_leaves
+            chunk = pallas_chunk_leaves(n)
+        else:
+            chunk = expand.clamp_chunk(None, n, batch)
+        if cfg is not None and cfg.round_unroll is not None:
+            round_unroll = cfg.round_unroll
+        elif "round_unroll" in tuned:  # the tuner's measurement pin
+            round_unroll = tuned["round_unroll"]
+        else:
+            round_unroll = _prf.ROUND_UNROLL
+        return {
+            "chunk_leaves": chunk,
+            "dot_impl": pick("dot_impl", matmul128.default_impl()),
+            "aes_impl": pick("aes_impl", _prf._aes_pair_impl()),
+            "round_unroll": round_unroll,
+            "kernel_impl": kernel_impl,
+            "dispatch_group": pick("dispatch_group", None),
+        }
+
     def _dispatch_packed(self, pk: keygen.PackedKeys):
         """Dispatch one packed batch to the device and return the device
         array WITHOUT forcing a host sync: JAX async dispatch lets the
@@ -389,46 +472,24 @@ class DPF(object):
         cw1, cw2, last = pk.cw1, pk.cw2, pk.last
         n = self.table_num_entries
         depth = n.bit_length() - 1
-        kernel_impl = self._config.kernel_impl if self._config else "xla"
-        if self._config and self._config.chunk_leaves:
-            chunk = self._config.chunk_leaves
-        elif kernel_impl == "pallas" and self.prf_method != PRF_AES128:
-            # subtree-kernel chunk is bounded by per-tile VMEM state;
-            # the AES plane-level kernel uses the standard memory bound
-            from .ops.pallas_level import pallas_chunk_leaves
-            chunk = pallas_chunk_leaves(n)
-        else:
-            chunk = expand.choose_chunk(n, pk.batch)
-        chunk = min(chunk, n)
+        k = self.resolved_eval_knobs(pk.batch)
+        chunk = k["chunk_leaves"]
         if n % chunk:
             raise ValueError(
                 "chunk_leaves (%d) must divide table size %d" % (chunk, n))
-        from .core import prf as _prf
-        from .ops import matmul128
-        dot_impl = (self._config.dot_impl if self._config else
-                    matmul128.default_impl())
-        aes_impl = (self._config.aes_impl if self._config and
-                    self._config.aes_impl != "auto" else
-                    _prf._aes_pair_impl())
-        round_unroll = (self._config.round_unroll
-                        if self._config and
-                        self._config.round_unroll is not None
-                        else _prf.ROUND_UNROLL)
-        if kernel_impl == "dispatch":
-            out = expand.eval_dispatch(
+        if k["kernel_impl"] == "dispatch":
+            return expand.eval_dispatch(
                 cw1, cw2, last, self.table_device, depth=depth,
                 prf_method=self.prf_method, chunk_leaves=chunk,
-                group=(self._config.dispatch_group if self._config
-                       else None),
-                dot_impl=dot_impl, aes_impl=aes_impl,
-                round_unroll=round_unroll,
+                group=k["dispatch_group"],
+                dot_impl=k["dot_impl"], aes_impl=k["aes_impl"],
+                round_unroll=k["round_unroll"],
                 deadline=self.dispatch_deadline)
-            return out
         return expand.expand_and_contract(
             cw1, cw2, last, self.table_device, depth=depth,
             prf_method=self.prf_method, chunk_leaves=chunk,
-            dot_impl=dot_impl, aes_impl=aes_impl,
-            round_unroll=round_unroll, kernel_impl=kernel_impl)
+            dot_impl=k["dot_impl"], aes_impl=k["aes_impl"],
+            round_unroll=k["round_unroll"], kernel_impl=k["kernel_impl"])
 
     def _mixed_batch(self, keys):
         """Deserialize + validate a radix-4 key batch (uniform n)."""
@@ -443,39 +504,30 @@ class DPF(object):
 
     def _dispatch_packed_r4(self, pk: keygen.PackedKeys):
         """Radix-4 device dispatch (core/radix4.py engines), async like
-        ``_dispatch_packed``."""
-        from .core import prf as _prf
+        ``_dispatch_packed``.  Shares the tuned-knob resolution."""
         from .core import radix4
-        from .ops import matmul128
         cw1, cw2, last = pk.cw1, pk.cw2, pk.last
         n = self.table_num_entries
-        cfg = self._config
-        chunk = (cfg.chunk_leaves if cfg and cfg.chunk_leaves
-                 else expand.choose_chunk(n, pk.batch))
-        dot_impl = cfg.dot_impl if cfg else matmul128.default_impl()
-        aes_impl = (cfg.aes_impl if cfg and cfg.aes_impl != "auto"
-                    else _prf._aes_pair_impl())
-        round_unroll = (cfg.round_unroll if cfg and
-                        cfg.round_unroll is not None else _prf.ROUND_UNROLL)
-        if cfg and cfg.kernel_impl == "pallas":
+        k = self.resolved_eval_knobs(pk.batch)
+        if k["kernel_impl"] == "pallas":
             out = radix4.expand_and_contract_mixed_pallas(
                 cw1, cw2, last, self.table_device, n=n,
-                prf_method=self.prf_method, aes_impl=aes_impl,
-                dot_impl=dot_impl)
-        elif cfg and cfg.kernel_impl == "dispatch":
+                prf_method=self.prf_method, aes_impl=k["aes_impl"],
+                dot_impl=k["dot_impl"])
+        elif k["kernel_impl"] == "dispatch":
             out = radix4.eval_dispatch_mixed(
                 cw1, cw2, last, self.table_device, n=n,
-                prf_method=self.prf_method, chunk_leaves=chunk,
-                group=cfg.dispatch_group,
-                dot_impl=dot_impl, aes_impl=aes_impl,
-                round_unroll=round_unroll,
+                prf_method=self.prf_method, chunk_leaves=k["chunk_leaves"],
+                group=k["dispatch_group"],
+                dot_impl=k["dot_impl"], aes_impl=k["aes_impl"],
+                round_unroll=k["round_unroll"],
                 deadline=self.dispatch_deadline)
         else:
             out = radix4.expand_and_contract_mixed(
                 cw1, cw2, last, self.table_device, n=n,
-                prf_method=self.prf_method, chunk_leaves=chunk,
-                dot_impl=dot_impl, aes_impl=aes_impl,
-                round_unroll=round_unroll)
+                prf_method=self.prf_method, chunk_leaves=k["chunk_leaves"],
+                dot_impl=k["dot_impl"], aes_impl=k["aes_impl"],
+                round_unroll=k["round_unroll"])
         return out
 
     # ------------------------------------------------------------ eval_cpu

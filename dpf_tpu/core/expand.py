@@ -40,15 +40,58 @@ from .prf import prf_pair
 
 MAX_CW = 64  # codeword slots in the wire format (2 per level, depth <= 32)
 
+# Live-seed budget for phase 2: the [B, C] x 16-byte seed tensor of one
+# scanned subtree group.  choose_chunk and the autotuner's candidate
+# generator (chunk_candidates) both honor it; 64 MiB keeps phase 2 well
+# under VMEM-spill territory on TPU and cache-resident on CPU.
+CHUNK_SEED_BYTES_BOUND = 1 << 26  # 64 MiB
+
+_CHUNK_FLOOR = 256  # below this, scan overhead dominates any memory win
+
+
+def chunk_within_bound(c: int, batch: int) -> bool:
+    """True when a [B, C] seed tensor fits the 64 MiB budget (the floor
+    chunk is always allowed: for batch <= 16384 it still fits exactly)."""
+    return c <= _CHUNK_FLOOR or c * 16 * max(1, batch) <= \
+        CHUNK_SEED_BYTES_BOUND
+
 
 def choose_chunk(n: int, batch: int) -> int:
     """Leaves per phase-2 step: bound the live seed tensor at 64 MiB
     (B x C x 16 B with C = max(256, 2^22 / B); at B=512, C=8192)."""
-    target = max(256, (1 << 22) // max(1, batch))
+    target = max(_CHUNK_FLOOR, (CHUNK_SEED_BYTES_BOUND // 16)
+                 // max(1, batch))
     c = 1
     while c * 2 <= min(n, target):
         c *= 2
     return c
+
+
+def clamp_chunk(chunk, n: int, batch: int) -> int:
+    """Harden a possibly-tuned ``chunk_leaves`` against the live-seed
+    budget: a falsy or over-budget value (e.g. a nearest-batch tuning
+    cache fallback pairing a small-batch chunk with a bigger batch)
+    falls back to the heuristic.  Shared by the single-chip and mesh
+    resolution paths."""
+    if not chunk or not chunk_within_bound(chunk, batch):
+        chunk = choose_chunk(n, batch)
+    return min(int(chunk), n)
+
+
+def chunk_candidates(n: int, batch: int, span: int = 2) -> list:
+    """``chunk_leaves`` candidates for the autotuner: powers of two within
+    ``span`` octaves of the ``choose_chunk`` heuristic, each a divisor of
+    the power-of-two ``n`` and each honoring the same 64 MiB live-seed
+    bound (candidates above it are dropped, not clipped).  The heuristic
+    itself is always a member, so a tuned config can never regress the
+    static default's memory envelope.  Sorted ascending."""
+    base = choose_chunk(n, batch)
+    out = set()
+    for s in range(-span, span + 1):
+        c = base << s if s >= 0 else base >> (-s)
+        if 1 <= c <= n and chunk_within_bound(c, batch):
+            out.add(c)
+    return sorted(out)
 
 
 def _level_step_pair(seeds, cw1_pair, cw2_pair, prf_method: int,

@@ -84,6 +84,21 @@ IMPLS = {"i32": dot_i32, "mxu": dot_i32_mxu}
 _DEFAULT_IMPL = "i32"
 
 
+def available_impls() -> tuple:
+    """Registered contraction backends, in registry order — the
+    autotuner's ``dot_impl`` candidate list.  Every member is bit-exact
+    mod 2^32 (test_ops.py), so the tuner may flip between them freely."""
+    return tuple(IMPLS)
+
+
+def register_impl(name: str, fn) -> None:
+    """Add a contraction backend to the registry (and thus to the
+    autotuner's search space).  ``fn(a, b)`` must be an exact wrapping
+    int32 matmul — the tuner's equality gate will reject it per shape
+    otherwise, but registering a non-exact impl is still a bug."""
+    IMPLS[name] = fn
+
+
 def set_dot_impl(name: str):
     """Select the default contraction backend: "i32" or "mxu".
 

@@ -254,7 +254,16 @@ class ShardedDPFServer:
         else:
             self.table_sharded = shard_table(tbl, self.mesh)
         shard_rows = self.n // self.mesh.shape["table"]
-        self.chunk = min(expand.choose_chunk(self.n, batch_size), shard_rows)
+        # tuned chunk_leaves (persistent tuning cache, keyed by device
+        # fingerprint x shape) when one exists for this shape, else the
+        # static heuristic — capped at the shard height either way
+        from ..tune.cache import lookup_eval_knobs
+        tuned = lookup_eval_knobs(
+            n=self.n, entry_size=self.entry_size, batch=batch_size,
+            prf_method=prf_method, scheme="logn", radix=radix) or {}
+        self.chunk = min(expand.clamp_chunk(tuned.get("chunk_leaves"),
+                                            self.n, batch_size),
+                         shard_rows)
 
     def _decode_batch(self, keys):
         """Vectorized ingest: wire keys -> PackedKeys validated against
@@ -292,6 +301,13 @@ class ShardedDPFServer:
     def eval(self, keys) -> np.ndarray:
         pk = self._decode_batch(keys)
         return np.asarray(self._dispatch_packed(pk))[:pk.batch]
+
+    def resolved_eval_knobs(self, batch: int) -> dict:
+        """The mesh path's effective program knobs (for benchmark
+        records — serve/engine.py ``resolved_config``)."""
+        from ..ops import matmul128
+        return {"chunk_leaves": self.chunk,
+                "dot_impl": matmul128.default_impl()}
 
     def serving_engine(self, **kwargs):
         """Mesh-path ``ServingEngine`` (serve/engine.py) over this server."""

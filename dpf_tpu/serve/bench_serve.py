@@ -175,6 +175,10 @@ def stream_bench(n=1024, entry_size=16, batch=256, batches=24, prf=None,
         "engine_elapsed_s": round(engine_s, 4),
         "max_in_flight": max_in_flight,
         "buckets": list(engine.buckets.sizes),
+        # the effective program shape (bucket ladder, in-flight window,
+        # dot_impl, chunk_leaves, ...), so BENCH_* files are
+        # self-describing about what actually ran
+        "resolved_config": engine.resolved_config(),
         "engine_stats": engine.stats.as_dict(),
         "ingest_microbench": micro,
         "checked": True,  # bit-exact equality gate ran before timing

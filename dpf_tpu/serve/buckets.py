@@ -47,6 +47,24 @@ class Buckets:
             s //= fanout
         return tuple(reversed(out))
 
+    @staticmethod
+    def ladder_candidates(cap: int) -> list:
+        """The autotuner's ladder search space (tune/serve_tune.py):
+        the default /2x4 ladder, a sparser /4x2, a two-rung /2, and the
+        single-bucket ladder — spanning the compile-count vs pad-waste
+        tradeoff.  Deduplicated, order preserved."""
+        cands = [
+            Buckets.default_sizes(cap, fanout=2, count=4),
+            Buckets.default_sizes(cap, fanout=4, count=2),
+            Buckets.default_sizes(cap, fanout=2, count=2),
+            Buckets.default_sizes(cap, fanout=2, count=1),
+        ]
+        out = []
+        for c in cands:
+            if c not in out:
+                out.append(c)
+        return out
+
     def bucket_for(self, b: int) -> int:
         """Smallest bucket >= b (b must be in (0, max])."""
         if b < 1:

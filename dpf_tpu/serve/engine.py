@@ -123,6 +123,17 @@ class ServingEngine:
         self.stats = EngineCounters()
         self._queue = deque()     # _Part refs, dispatch order, unresolved
         self._pending = deque()   # futures with unresolved parts, FIFO
+        # Persistent XLA compilation cache, on by default for the serve
+        # path (disable: DPF_TPU_COMPILE_CACHE=0): warmup is real
+        # serving latency, and a warm cache turns each bucket's compile
+        # into a deserialize on every process after the first.
+        try:
+            from ..tune import compcache
+            compcache.enable()
+        except Exception:  # cache must never break serving
+            pass
+        if warmup:
+            self.warmup()
 
     # ------------------------------------------------------------- submit
 
@@ -219,14 +230,34 @@ class ServingEngine:
 
     # ------------------------------------------------------------- warmup
 
-    def warmup(self) -> None:
+    def warmup(self, tune: bool = False, trace=None) -> None:
         """Precompile every bucket's program with synthetic keys.
 
         A zero-codeword key with a valid header (depth/n) decodes into
         the exact array shapes real traffic produces, so each dispatch
         here populates the jit cache for one bucket size; outputs are
         discarded and none of the serving counters move.
+
+        ``tune=True`` first re-tunes the serving knobs in place: the
+        persistent tuning cache (``tune/cache.py``) is consulted for
+        this (device, table shape, cap) and, on a miss, the grid search
+        (``tune.serve_tune.tune_serving``) runs against a synthetic
+        arrival trace (or ``trace``, a list of batch sizes) — the
+        engine's ``buckets`` and ``max_in_flight`` are then replaced by
+        the measured winner before the precompile loop runs.  Searching
+        needs a server that can mint keys (``api.DPF``); on the mesh
+        path a cache miss leaves the knobs untouched.
         """
+        if tune:
+            from ..tune.serve_tune import lookup_serve_knobs, tune_serving
+            cap = self.buckets.max
+            knobs = lookup_serve_knobs(self._server, cap)
+            if knobs is None and hasattr(self._server, "gen"):
+                knobs = tune_serving(self._server, cap=cap,
+                                     trace=trace)["knobs"]
+            if knobs:
+                self.buckets = Buckets(knobs["buckets"])
+                self.max_in_flight = int(knobs["max_in_flight"])
         from ..core.keygen import PackedKeys
         depth = self._n.bit_length() - 1
         for size in self.buckets.sizes:
@@ -238,6 +269,22 @@ class ServingEngine:
             np.asarray(self._server._dispatch_packed(pk))
 
     # ------------------------------------------------------------ plumbing
+
+    def resolved_config(self) -> dict:
+        """The engine's effective program-shape config — bucket ladder,
+        in-flight window, and (when the server exposes its resolution,
+        ``DPF.resolved_eval_knobs``) the eval knobs of the cap-size
+        program.  Benchmark records embed this so every BENCH_* file is
+        self-describing about what actually ran."""
+        d = {"buckets": list(self.buckets.sizes),
+             "max_in_flight": self.max_in_flight}
+        rk = getattr(self._server, "resolved_eval_knobs", None)
+        if callable(rk):
+            try:
+                d.update(rk(self.buckets.max))
+            except Exception:  # diagnostics must never break serving
+                pass
+        return d
 
     def _check_deadline(self):
         if self.deadline is not None and time.time() > self.deadline:

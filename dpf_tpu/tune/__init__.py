@@ -1,0 +1,23 @@
+"""Hardware-aware autotuning for the fused eval + serving program space.
+
+Measure, don't guess (ROADMAP: "as fast as the hardware allows"): the
+program-shape knobs the rest of the repo fixes by static heuristic —
+``chunk_leaves``, ``dot_impl``, ``aes_impl``, ``kernel_impl``,
+``dispatch_group`` for the fused eval (``search.tune_eval``), the bucket
+ladder and ``max_in_flight`` for the serving engine
+(``serve_tune.tune_serving``) — are searched by staged coordinate
+descent, every timed candidate equality-gated against the scalar
+oracle, and the winners persisted in a JSON cache keyed by device
+fingerprint x shape (``cache``/``fingerprint``).  ``compcache`` wires
+JAX's persistent compilation cache alongside, so tuned programs also
+skip the XLA recompile across processes.  See docs/TUNING.md.
+"""
+
+from .cache import (  # noqa: F401
+    TuningCache, default_cache, lookup_eval_knobs)
+from .compcache import enable as enable_compilation_cache  # noqa: F401
+from .fingerprint import cache_key, device_fingerprint  # noqa: F401
+from .search import (  # noqa: F401
+    autotune_sweep, heuristic_knobs, stage_candidates, tune_eval)
+from .serve_tune import (  # noqa: F401
+    lookup_serve_knobs, synthetic_trace, tune_serving)

@@ -1,0 +1,51 @@
+"""Cache keys for the autotuner: device fingerprint x program shape.
+
+A tuned knob set is only valid for the (hardware, program-shape) pair it
+was measured on — the whole point of measuring instead of guessing is
+that a v4 TPU, a v5e, and a laptop CPU each pick differently.  The key
+has two halves:
+
+* ``device_fingerprint()`` — backend kind, device model, device count,
+  and the jax/jaxlib versions (an XLA upgrade can shift the optimum, so
+  it invalidates tuned entries rather than silently serving stale ones).
+* ``shape_key()`` — the static program shape: (N, E, B, prf, scheme,
+  radix).  These are exactly the static arguments of the fused eval jit
+  (core/expand.py), so one entry per key covers one compiled program
+  family.
+
+``cache_key(kind, ...)`` joins both under a ``kind`` tag ("eval" for the
+fused-eval knobs, "serve" for the engine's ladder/in-flight knobs).
+"""
+
+from __future__ import annotations
+
+
+def device_fingerprint() -> str:
+    """Stable id of the measuring hardware+toolchain, e.g.
+    ``cpu/cpu/x1/jax0.4.37+jaxlib0.4.36``."""
+    import jax
+    try:
+        import jaxlib
+        jl = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover
+        jl = "?"
+    devs = jax.devices()
+    kind = (devs[0].device_kind if devs else "none").replace(" ", "_")
+    return "%s/%s/x%d/jax%s+jaxlib%s" % (
+        jax.default_backend(), kind, len(devs), jax.__version__, jl)
+
+
+def shape_key(*, n: int, entry_size: int, batch: int, prf_method: int,
+              scheme: str = "logn", radix: int = 2) -> str:
+    return "n%d.e%d.b%d.prf%d.%s.r%d" % (
+        n, entry_size, batch, prf_method, scheme, radix)
+
+
+def cache_key(kind: str, *, n: int, entry_size: int, batch: int,
+              prf_method: int, scheme: str = "logn", radix: int = 2,
+              fingerprint: str | None = None) -> str:
+    """Full tuning-cache key: ``<kind>|<device>|<shape>``."""
+    fp = fingerprint if fingerprint is not None else device_fingerprint()
+    return "%s|%s|%s" % (kind, fp, shape_key(
+        n=n, entry_size=entry_size, batch=batch, prf_method=prf_method,
+        scheme=scheme, radix=radix))

@@ -1,0 +1,186 @@
+"""Serving-knob tuner: bucket ladder x in-flight window vs a synthetic
+arrival trace.
+
+The engine's two knobs trade compile count, pad waste, and host/device
+overlap: a dense ladder wastes less padding but compiles more programs
+and reuses each less; a deeper in-flight window hides more host time on
+an async backend but buys nothing on a synchronous one.  Neither is
+predictable from first principles across backends — so, like the eval
+knobs, they are *measured*: a deterministic synthetic trace of ragged
+batch sizes is replayed through every (ladder, max_in_flight) candidate
+(grid search — the space is tiny), each candidate's outputs are
+equality-gated against the blocking ``eval_tpu`` loop on the identical
+stream, and the sustained-qps winner persists in the tuning cache under
+the ``serve|...`` key.
+
+``ServingEngine.warmup(tune=True)`` consults the cache first and only
+searches on a miss (and only when its server can mint keys — the plain
+``api.DPF``); ``benchmark.py --autotune`` forces the full search.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .cache import TuningCache, default_cache
+from .fingerprint import cache_key, device_fingerprint
+
+
+def synthetic_trace(cap: int, batches: int = 16, seed: int = 7) -> list:
+    """A deterministic ragged arrival trace: ~half full batches (the
+    loaded-server regime), the rest a mix of half-size and uniform
+    stragglers, so every ladder rung and the remainder path get
+    exercised.  Returns a list of batch sizes in [1, cap]."""
+    rng = np.random.default_rng(seed)
+    sizes = []
+    for _ in range(batches):
+        r = rng.random()
+        if r < 0.5:
+            sizes.append(cap)
+        elif r < 0.8:
+            sizes.append(max(1, cap // 2))
+        else:
+            sizes.append(int(rng.integers(1, cap + 1)))
+    return sizes
+
+
+def serve_shape_of(server) -> dict:
+    """The cache-key shape fields of a prepared server (api.DPF or
+    ShardedDPFServer)."""
+    n = getattr(server, "table_num_entries", None) or server.n
+    e = (getattr(server, "table_effective_entry_size", None)
+         or getattr(server, "entry_size"))
+    return {
+        "n": int(n), "entry_size": int(e),
+        "prf_method": server.prf_method,
+        "scheme": getattr(server, "scheme", "logn"),
+        "radix": getattr(server, "radix", 2),
+    }
+
+
+def lookup_serve_knobs(server, cap: int,
+                       cache: TuningCache | None = None) -> dict | None:
+    """Tuned (buckets, max_in_flight) for this server shape, or None.
+    Never raises — an unreadable cache is a miss."""
+    try:
+        cache = cache if cache is not None else default_cache()
+        rec = cache.lookup(
+            cache_key("serve", batch=cap, **serve_shape_of(server)))
+        return rec.get("knobs") if rec else None
+    except Exception:  # pragma: no cover — cache must never break serving
+        return None
+
+
+def tune_serving(dpf, *, cap: int | None = None, trace=None,
+                 in_flight=(1, 2, 4), ladders=None, reps: int = 2,
+                 distinct: int = 16, cache: TuningCache | None = None,
+                 force: bool = False, log=None) -> dict:
+    """Measure (ladder, max_in_flight) candidates on ``dpf`` (a prepared
+    ``api.DPF``) and persist the winner.  Returns the cache record with
+    a transient ``searched`` field (False = warm cache, nothing ran)."""
+    from ..serve.buckets import Buckets
+    from ..serve.engine import ServingEngine
+
+    cache = cache if cache is not None else default_cache()
+    shape = serve_shape_of(dpf)
+    cap = int(cap or min(dpf.BATCH_SIZE, 512))
+    key = cache_key("serve", batch=cap, **shape)
+    if not force:
+        rec = cache.lookup(key)
+        if rec is not None:
+            return {**rec, "searched": False}
+
+    n = shape["n"]
+    trace = list(trace) if trace is not None else synthetic_trace(cap)
+    if max(trace) > cap:
+        raise ValueError("trace batch %d exceeds cap %d"
+                         % (max(trace), cap))
+    ks = [dpf.gen((i * 0x9E3779B1) % n, n, seed=b"serve-tune-%d" % i)[0]
+          for i in range(distinct)]
+    stream = [[ks[(j + i) % distinct] for i in range(b)]
+              for j, b in enumerate(trace)]
+    total = sum(trace)
+    # the equality-gate target: the blocking loop on the identical stream
+    reference = [np.asarray(dpf.eval_tpu(b)) for b in stream]
+
+    candidates = []
+    for ladder in (ladders if ladders is not None
+                   else Buckets.ladder_candidates(cap)):
+        for mif in in_flight:
+            candidates.append((tuple(ladder), int(mif)))
+    best = None  # (elapsed_s, ladder, mif, stats)
+    tried = rejected = 0
+    for ladder, mif in candidates:
+        tried += 1
+        try:
+            engine = ServingEngine(dpf, max_in_flight=mif, buckets=ladder,
+                                   warmup=True)
+            futs = [engine.submit(b) for b in stream]
+            engine.drain()
+            if not all(np.array_equal(r, f.result())
+                       for r, f in zip(reference, futs)):
+                rejected += 1
+                if log:
+                    log("  reject (diverged): %s mif=%d" % (ladder, mif))
+                continue
+            elapsed = float("inf")
+            for _ in range(reps):
+                engine = ServingEngine(dpf, max_in_flight=mif,
+                                       buckets=ladder)
+                t0 = time.perf_counter()
+                futs = [engine.submit(b) for b in stream]
+                engine.drain()
+                elapsed = min(elapsed, time.perf_counter() - t0)
+        except Exception as exc:
+            rejected += 1
+            if log:
+                log("  reject (%s): %s mif=%d"
+                    % (type(exc).__name__, ladder, mif))
+            continue
+        if log:
+            log("  ladder=%s mif=%d -> %d qps"
+                % (list(ladder), mif, int(total / elapsed)))
+        if best is None or elapsed < best[0]:
+            best = (elapsed, ladder, mif, engine.stats.as_dict())
+    if best is None:
+        raise AssertionError("no serving candidate passed the gate")
+    elapsed, ladder, mif, stats = best
+    record = {
+        "knobs": {"buckets": list(ladder), "max_in_flight": mif},
+        "measured": {
+            "elapsed_s": round(elapsed, 6),
+            "qps": int(total / elapsed),
+            "trace": trace, "cap": cap, "reps": reps,
+            "candidates_tried": tried, "rejected": rejected,
+            "engine_stats": stats,
+        },
+        "fingerprint": device_fingerprint(),
+        "gated": True,  # winner matched the blocking loop bit-for-bit
+    }
+    cache.store(key, record)
+    return {**record, "searched": True}
+
+
+def tune_serving_shape(*, n: int, cap: int, entry_size: int = 16,
+                       prf_method: int = 0, cache=None, force=False,
+                       reps: int = 2) -> dict:
+    """Standalone-sweep entry: build a synthetic server for the shape,
+    tune its serving knobs, and return a summary row."""
+    import dpf_tpu
+
+    dpf = dpf_tpu.DPF(prf=prf_method)
+    table = np.random.default_rng(n ^ 0x5e12).integers(
+        0, 2 ** 31, (n, entry_size), dtype=np.int32, endpoint=False)
+    dpf.eval_init(table)
+    rec = tune_serving(dpf, cap=cap, cache=cache, force=force, reps=reps)
+    m = rec["measured"]
+    return {
+        "entries": n, "cap": cap,
+        "tuned_knobs": rec["knobs"],
+        "qps": m["qps"], "elapsed_s": m["elapsed_s"],
+        "candidates_tried": m["candidates_tried"],
+        "rejected": m["rejected"],
+        "from_cache": not rec["searched"],
+    }

@@ -148,6 +148,34 @@ class EngineCounters:
         return d
 
 
+@dataclasses.dataclass
+class CacheCounters:
+    """Process-wide cache-effectiveness counters (tune/ subsystem).
+
+    ``tuning_*`` move on every persistent-tuning-cache lookup
+    (``tune/cache.py``); ``compile_*`` mirror JAX's
+    ``/jax/compilation_cache/*`` monitoring events once
+    ``tune.compcache.enable()`` has registered its listener.  A warm
+    second process shows ``tuning_hits > 0`` (autotune search skipped)
+    and ``compile_hits > 0`` (XLA recompile skipped) — the assertion
+    the warm-start test makes.
+    """
+    tuning_hits: int = 0
+    tuning_misses: int = 0
+    tuning_stores: int = 0
+    compile_hits: int = 0
+    compile_misses: int = 0
+    compile_time_saved_s: float = 0.0
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["compile_time_saved_s"] = round(d["compile_time_saved_s"], 4)
+        return d
+
+
+CACHE_COUNTERS = CacheCounters()
+
+
 class Timer:
     """Wall-clock block timer that blocks on device completion."""
 

@@ -15,6 +15,14 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Hermetic caches: the tune/ subsystem persists tuning results and XLA
+# executables under ~/.cache by default — tests must neither read a
+# developer's warm caches (exported env vars included) nor leave state
+# behind.  Tests that exercise the caches point them at tmp paths
+# explicitly (monkeypatch.setenv).
+os.environ["DPF_TPU_TUNE_CACHE"] = "0"
+os.environ["DPF_TPU_COMPILE_CACHE"] = "0"
+
 from dpf_tpu.utils.hermetic import force_cpu_mesh  # noqa: E402
 
 force_cpu_mesh(8)

@@ -41,6 +41,39 @@ def test_dot_impl_switch():
         matmul128.set_dot_impl("nope")
 
 
+def test_dot_mxu_vs_i32_wrapping_parity_fuzzed():
+    """dot_i32_mxu must agree with dot_i32 bit-for-bit under heavy int32
+    wraparound — the autotuner flips ``dot_impl`` per shape on timing
+    alone, so the two impls must be interchangeable on ANY input.  The
+    fuzz mixes full-range negatives with forced extreme values
+    (INT32_MIN, INT32_MAX, -1) so limb-bias corrections and accumulator
+    overflow are both exercised."""
+    rng = np.random.default_rng(0xD07)
+    extremes = np.array([-2 ** 31, 2 ** 31 - 1, -1, 0, 1], np.int32)
+    f_i32 = jax.jit(matmul128.dot_i32)
+    f_mxu = jax.jit(matmul128.dot_i32_mxu)
+    for trial in range(8):
+        bsz = int(rng.integers(1, 33))
+        k = int(rng.integers(1, 513))
+        e = int(rng.integers(1, 17))
+        a = rng.integers(-2 ** 31, 2 ** 31, (bsz, k),
+                         dtype=np.int64).astype(np.int32)
+        b = rng.integers(-2 ** 31, 2 ** 31, (k, e),
+                         dtype=np.int64).astype(np.int32)
+        # salt ~10% of each operand with exact extremes
+        for arr in (a, b):
+            mask = rng.random(arr.shape) < 0.1
+            arr[mask] = rng.choice(extremes, size=int(mask.sum()))
+        got_i32 = np.asarray(f_i32(jnp.asarray(a), jnp.asarray(b)))
+        got_mxu = np.asarray(f_mxu(jnp.asarray(a), jnp.asarray(b)))
+        assert np.array_equal(got_i32, got_mxu), \
+            "impl divergence at trial %d (B=%d K=%d E=%d)" % (trial, bsz,
+                                                              k, e)
+        # and both match the exact big-int oracle, not just each other
+        if trial < 2:
+            assert (got_i32.astype(np.uint32) == _exact_mod32(a, b)).all()
+
+
 def test_prf_pair_matches_single_calls():
     rng = np.random.default_rng(9)
     ints = [int.from_bytes(rng.bytes(16), "little") for _ in range(9)]
