@@ -10,6 +10,12 @@
 # candidate equality-gated against the scalar oracle; winners persist
 # in the tuning cache and the sweep record is written with --out
 # (committed as BENCH_TUNE_r07.json).  See docs/TUNING.md.
+#
+# benchmark.py --autotune-scheme goes one level up: it races the three
+# constructions (logn vs radix-4 vs sqrtn) per (N, B) point — each
+# knob-tuned and equality-gated first — and persists the per-shape
+# winning construction in the same tuning cache (committed record:
+# BENCH_SCHEME_r08.json).
 
 import sys
 
@@ -45,7 +51,37 @@ def _autotune_main(argv):
                    out=args.out)
 
 
+def _autotune_scheme_main(argv):
+    import argparse
+
+    from dpf_tpu.tune.search import DEFAULT_SWEEP, scheme_sweep
+
+    ap = argparse.ArgumentParser(
+        description="scheme-level autotune: logn vs radix-4 vs sqrtn "
+                    "per (N, B) point (docs/TUNING.md)")
+    ap.add_argument("--shapes", default=None,
+                    help="comma list of N:B points (default %s)"
+                         % ",".join("%d:%d" % s for s in DEFAULT_SWEEP))
+    ap.add_argument("--prf", type=int, default=0,
+                    help="PRF id (default 0=DUMMY; 2=ChaCha20, 3=AES128)")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--force", action="store_true",
+                    help="re-measure even with a warm tuning cache")
+    ap.add_argument("--out", help="also write the JSON record to a file")
+    args = ap.parse_args(argv)
+    shapes = DEFAULT_SWEEP
+    if args.shapes:
+        shapes = tuple(tuple(int(x) for x in p.split(":"))
+                       for p in args.shapes.split(","))
+    scheme_sweep(shapes, prf_method=args.prf, reps=args.reps,
+                 force=args.force, out=args.out)
+
+
 if __name__ == "__main__":
+    if "--autotune-scheme" in sys.argv:
+        _autotune_scheme_main(
+            [a for a in sys.argv[1:] if a != "--autotune-scheme"])
+        sys.exit(0)
     if "--autotune" in sys.argv:
         _autotune_main([a for a in sys.argv[1:] if a != "--autotune"])
         sys.exit(0)

@@ -13,6 +13,7 @@ from .core.prf_ref import (  # noqa: F401
     PRF_AES128, PRF_CHACHA20, PRF_CHACHA20_BLK, PRF_DUMMY, PRF_SALSA20,
     PRF_SALSA20_BLK)
 from .core.sqrtn import (  # noqa: F401 — O(sqrt N) flat construction
-    SqrtKey, deserialize_sqrt_key, generate_sqrt_keys)
+    PackedSqrtKeys, SqrtKey, decode_sqrt_keys_batched,
+    deserialize_sqrt_key, generate_sqrt_keys)
 
 __version__ = "0.1.0"

@@ -90,11 +90,18 @@ class DPF(object):
 
     DEFAULT_PRF = PRF_AES128
 
-    def __init__(self, prf=None, strict=True, config=None):
+    def __init__(self, prf=None, strict=True, config=None, scheme=None):
         """config: optional utils.config.EvalConfig consolidating the
         runtime knobs (prf_method, batch_size, chunk_leaves, dot_impl,
         aes_impl, round_unroll) — the replacement for the reference's
-        compile-time -D flag tiers."""
+        compile-time -D flag tiers.
+
+        scheme: construction selector ("logn"/"sqrtn") as a direct
+        argument, so scripts don't need a full EvalConfig for it.  It
+        wins over a ``config.scheme`` left at the "logn" default (a
+        frozen dataclass can't tell default from explicit, and knob-only
+        configs must stay combinable); a config pinned to a different
+        non-default construction raises."""
         self._config = config
         self.radix = 2
         self.scheme = "logn"
@@ -103,13 +110,21 @@ class DPF(object):
                 prf = config.prf_method
             self.BATCH_SIZE = config.batch_size
             self.radix = getattr(config, "radix", 2)
-            if self.radix not in (2, 4):
-                raise ValueError("radix must be 2 or 4")
             self.scheme = getattr(config, "scheme", "logn")
-            if self.scheme not in ("logn", "sqrtn"):
-                raise ValueError("scheme must be 'logn' or 'sqrtn'")
-            if self.scheme == "sqrtn" and self.radix == 4:
-                raise ValueError("scheme='sqrtn' has no radix; use radix=2")
+        if scheme is not None:
+            if (config is not None and self.scheme != "logn"
+                    and scheme != self.scheme):
+                raise ValueError("scheme=%r conflicts with config.scheme=%r"
+                                 % (scheme, self.scheme))
+            self.scheme = scheme
+        # the ONE validation point for the construction selectors — the
+        # config and direct-argument spellings both land here
+        if self.radix not in (2, 4):
+            raise ValueError("radix must be 2 or 4")
+        if self.scheme not in ("logn", "sqrtn"):
+            raise ValueError("scheme must be 'logn' or 'sqrtn'")
+        if self.scheme == "sqrtn" and self.radix == 4:
+            raise ValueError("scheme='sqrtn' has no radix; use radix=2")
         self.prf_method = self.DEFAULT_PRF if prf is None else prf
         self.prf_method_string = PRF_NAMES[self.prf_method]
         self.strict = strict          # enforce reference shape limits
@@ -338,40 +353,19 @@ class DPF(object):
                 raise ValueError("keys for mixed sqrt-N splits")
         return sk
 
-    def _eval_batch_sqrt(self, keys) -> np.ndarray:
-        """Sqrt-N device evaluation: flat PRF grid + fused contraction
-        (core/sqrtn.py), natural-order table."""
-        from .core import sqrtn
-        from .ops import matmul128
-        sk = self._sqrt_batch(keys)
-        n = self.table_num_entries
-        for k in sk:
-            if k.n != n:
-                raise ValueError(
-                    "key generated for n=%d but table has n=%d" % (k.n, n))
-        from .utils.config import is_auto
-        seeds, cw1, cw2 = sqrtn.pack_sqrt_keys(sk)
-        dot_impl = (self._config.dot_impl
-                    if self._config is not None and
-                    not is_auto(self._config.dot_impl)
-                    else matmul128.default_impl())
-        out = sqrtn.eval_contract_batched(
-            seeds, cw1, cw2, self.table_device,
-            prf_method=self.prf_method, dot_impl=dot_impl)
-        return np.asarray(out)
-
     def _eval_batch(self, keys) -> np.ndarray:
-        if self.scheme == "sqrtn":
-            return self._eval_batch_sqrt(keys)
         return np.asarray(self._dispatch_packed(self._decode_batch(keys)))
 
-    def _decode_batch(self, keys) -> keygen.PackedKeys:
-        """Vectorized ingest: wire keys -> PackedKeys, validated against
-        the initialized table (shared with the serving engine)."""
+    def _decode_batch(self, keys):
+        """Vectorized ingest: wire keys -> packed batch, validated
+        against the initialized table (shared with the serving engine).
+        Returns ``keygen.PackedKeys`` for the logn schemes,
+        ``sqrtn.PackedSqrtKeys`` for scheme='sqrtn' — both via the
+        batched codec (one stacked buffer, O(1) Python decode ops)."""
         if self.scheme == "sqrtn":
-            raise NotImplementedError(
-                "scheme='sqrtn' has no packed-batch codec; use eval_tpu")
-        if self.radix == 4:
+            from .core import sqrtn
+            pk = sqrtn.decode_sqrt_keys_batched(keys)
+        elif self.radix == 4:
             from .core import radix4
             pk = radix4.decode_mixed_keys_batched(keys)
         else:
@@ -396,6 +390,11 @@ class DPF(object):
         but the process-global fallbacks (``matmul128.default_impl``,
         the AES pair impl, ``ROUND_UNROLL``) are re-read every call so
         ``set_dot_impl``/``apply_globals`` stay live between dispatches.
+
+        scheme='sqrtn' resolves its own two-knob space (``dot_impl``,
+        ``row_chunk``) under the same precedence; ``row_chunk`` may
+        come back None — the dispatch path resolves it against the
+        decoded batch's key split (``sqrtn.clamp_row_chunk``).
         """
         from .core import prf as _prf
         from .ops import matmul128
@@ -406,9 +405,15 @@ class DPF(object):
             raise RuntimeError("Must call `eval_init` before resolving")
         tuned = self._tuned_cache.get(batch)
         if tuned is None:
-            if cfg is None or any(is_auto(v) for v in (
-                    cfg.chunk_leaves, cfg.dot_impl, cfg.kernel_impl,
-                    cfg.aes_impl, cfg.dispatch_group)):
+            if self.scheme == "sqrtn":
+                auto_fields = ((cfg.row_chunk, cfg.dot_impl)
+                               if cfg is not None else (None,))
+            else:
+                auto_fields = ((cfg.chunk_leaves, cfg.dot_impl,
+                                cfg.kernel_impl, cfg.aes_impl,
+                                cfg.dispatch_group)
+                               if cfg is not None else (None,))
+            if any(is_auto(v) for v in auto_fields):
                 from .tune.cache import lookup_eval_knobs
                 tuned = lookup_eval_knobs(
                     n=n, entry_size=self.table_effective_entry_size,
@@ -424,6 +429,17 @@ class DPF(object):
                 return explicit
             v = tuned.get(field)
             return v if v is not None else fallback
+
+        if self.scheme == "sqrtn":
+            # the sqrtn program has exactly two knobs; row_chunk's
+            # heuristic needs the key split (K, R), which only the
+            # decoded batch knows — a None here is resolved at dispatch
+            # by sqrtn.clamp_row_chunk, which also re-checks tuned
+            # values against the live-slab budget
+            return {
+                "dot_impl": pick("dot_impl", matmul128.default_impl()),
+                "row_chunk": pick("row_chunk", None),
+            }
 
         kernel_impl = pick("kernel_impl", "xla")
         if cfg is not None and cfg.chunk_leaves:
@@ -467,6 +483,8 @@ class DPF(object):
         runs.  Blocking callers wrap the result in ``np.asarray``."""
         if self.table_device is None:
             raise RuntimeError("Must call `eval_init` before dispatch")
+        if self.scheme == "sqrtn":
+            return self._dispatch_packed_sqrt(pk)
         if self.radix == 4:
             return self._dispatch_packed_r4(pk)
         cw1, cw2, last = pk.cw1, pk.cw2, pk.last
@@ -490,6 +508,30 @@ class DPF(object):
             prf_method=self.prf_method, chunk_leaves=chunk,
             dot_impl=k["dot_impl"], aes_impl=k["aes_impl"],
             round_unroll=k["round_unroll"], kernel_impl=k["kernel_impl"])
+
+    def _dispatch_packed_sqrt(self, pk):
+        """Sqrt-N device dispatch: row-chunked fused PRF-grid evaluation
+        (``sqrtn.eval_contract_batched``), async like the logn paths.
+        Shares the tuned-knob resolution; a TUNED row_chunk is hardened
+        against THIS batch's key split and the live-slab budget
+        (``sqrtn.clamp_row_chunk`` — tuned entries key on the table
+        shape, not the split), while an EXPLICIT ``EvalConfig.row_chunk``
+        passes straight through so an invalid pin raises rather than
+        silently measuring the heuristic (the logn chunk_leaves rule)."""
+        from .core import sqrtn
+        from .utils.config import is_auto
+        kn = self.resolved_eval_knobs(pk.batch)
+        explicit = (self._config.row_chunk if self._config is not None
+                    else None)
+        if not is_auto(explicit):
+            rc = int(explicit)
+        else:
+            rc = sqrtn.clamp_row_chunk(kn["row_chunk"], pk.n_codewords,
+                                       pk.n_keys, pk.batch)
+        return sqrtn.eval_contract_batched(
+            pk.seeds, pk.cw1, pk.cw2, self.table_device,
+            prf_method=self.prf_method, dot_impl=kn["dot_impl"],
+            row_chunk=rc)
 
     def _mixed_batch(self, keys):
         """Deserialize + validate a radix-4 key batch (uniform n)."""

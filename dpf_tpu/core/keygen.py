@@ -85,13 +85,25 @@ class FlatKey:
         return slots.reshape(-1).view(np.int32).copy()
 
 
-def stack_wire_keys(keys) -> np.ndarray:
-    """Key batch (list of [524]-int32 array-likes, torch tensors included,
-    or one [B, 524] array) -> one contiguous [B, 524] int32 buffer.
+def _wire_words(k) -> np.ndarray:
+    """One wire key to a flat int32 array (torch tensors — device ones
+    included — detached to host first)."""
+    if hasattr(k, "detach"):
+        k = k.detach().cpu().numpy()
+    return np.asarray(k, dtype=np.int32).reshape(-1)
 
-    The single O(B) Python loop of the batched ingest path lives here; it
-    is a plain ``np.asarray`` per key (no per-limb Python-int work), and
-    is skipped entirely when the caller already holds a stacked array.
+
+def stack_wire_keys(keys, words: int | None = KEY_WORDS) -> np.ndarray:
+    """Key batch (list of flat int32 array-likes, torch tensors
+    included, or one [B, W] array) -> one contiguous [B, W] int32
+    buffer.
+
+    ``words`` is the required wire width; None accepts any width the
+    batch agrees on (the sqrt-N codec's O(sqrt N)-sized keys — there a
+    ragged batch raises the stacking ValueError).  The single O(B)
+    Python loop of the batched ingest path lives here; it is a plain
+    ``np.asarray`` per key (no per-limb Python-int work), and is skipped
+    entirely when the caller already holds a stacked array.
     """
     if len(keys) == 0:
         raise ValueError("empty key batch")
@@ -101,13 +113,12 @@ def stack_wire_keys(keys) -> np.ndarray:
         try:  # uniform numpy inputs stack in one C call
             arr = np.asarray(keys, dtype=np.int32)
         except (ValueError, TypeError, RuntimeError):
-            arr = np.stack([np.asarray(k, dtype=np.int32).reshape(-1)
-                            for k in keys])
+            arr = np.stack([_wire_words(k) for k in keys])
         if arr.ndim != 2:
             arr = arr.reshape(len(keys), -1)
-    if arr.shape[1] != KEY_WORDS:
+    if words is not None and arr.shape[1] != words:
         raise ValueError("DPF key must be %d int32 words, got %d"
-                         % (KEY_WORDS, arr.shape[1]))
+                         % (words, arr.shape[1]))
     return np.ascontiguousarray(arr)
 
 

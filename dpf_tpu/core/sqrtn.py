@@ -35,11 +35,16 @@ cw1[R] | cw2[R]]`` as uint128 little-endian slots viewed as int32.
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
 from . import u128
+from .expand import CHUNK_SEED_BYTES_BOUND
 from .keygen import Shake256Drbg
 from .prf import prf_v
 from .prf_ref import MASK128, PRF_FUNCS
@@ -147,10 +152,14 @@ def generate_sqrt_keys(alpha: int, n: int, seed: bytes, prf_method: int,
             SqrtKey(keys=keys2, cw1=cw1, cw2=cw2, **args))
 
 
-def _grid_vals(prf_method: int, seeds_row, r: int, xp):
-    """PRF values over rows 0..r-1 for a seed tensor broadcast along a
-    leading row axis (``seeds_row``: [..., 1, K, 4]-shaped broadcastable
-    maker, called with the row count to use).
+def _grid_vals(prf_method: int, seeds_row, r: int, xp,
+               row0=np.uint32(0)):
+    """PRF values over rows row0..row0+r-1 for a seed tensor broadcast
+    along a leading row axis (``seeds_row``: [..., 1, K, 4]-shaped
+    broadcastable maker, called with the row count to use).  ``row0``
+    may be a traced uint32 scalar (the chunked scan's row offset); it
+    must be a multiple of 4 whenever the caller chunks a larger grid
+    (``eval_contract_batched`` enforces this via the row_chunk rules).
 
     Block-PRG ids (4/5): rows 4c..4c+3 are the four word groups of ONE
     core block at counter c — evaluate ceil(r/4) blocks and interleave,
@@ -159,10 +168,11 @@ def _grid_vals(prf_method: int, seeds_row, r: int, xp):
     """
     from .prf import _BLK_WORDS_JAX, _BLK_WORDS_V, _blk_group
     if prf_method not in _BLK_WORDS_V:
-        rows = xp.arange(r, dtype=xp.uint32)[:, None]
+        rows = (xp.arange(r, dtype=xp.uint32) + row0)[:, None]
         return prf_v(prf_method, seeds_row(r), rows)
     nctr = -(-r // 4)
-    ctr = xp.arange(nctr, dtype=xp.uint32)[:, None]
+    ctr = (xp.arange(nctr, dtype=xp.uint32)
+           + (row0 >> np.uint32(2)))[:, None]
     seeds = seeds_row(nctr)
     if isinstance(seeds, np.ndarray):
         out16 = _BLK_WORDS_V[prf_method](seeds, ctr)
@@ -224,33 +234,221 @@ def pack_sqrt_keys(keys: list) -> tuple:
     return seeds, cw1, cw2
 
 
-def _eval_contract_batched_jit(seeds, cw1, cw2, table, *, prf_method,
-                               dot_impl):
-    import jax.numpy as jnp
+# ------------------------------------------------------ packed-batch codec
 
+@dataclass
+class PackedSqrtKeys:
+    """A sqrt-N key batch decoded straight into device-layout arrays —
+    the scheme's counterpart of ``keygen.PackedKeys``, with the same
+    ``batch``/``slice``/``pad_to`` surface so the serving engine's
+    bucket logic stays scheme-agnostic."""
+    seeds: np.ndarray    # [B, K, 4] uint32 column seeds
+    cw1: np.ndarray      # [B, R, 4] uint32
+    cw2: np.ndarray      # [B, R, 4] uint32
+    n: int               # shared table size (N = K * R)
+
+    @property
+    def n_keys(self) -> int:
+        return self.seeds.shape[1]
+
+    @property
+    def n_codewords(self) -> int:
+        return self.cw1.shape[1]
+
+    @property
+    def batch(self) -> int:
+        return self.seeds.shape[0]
+
+    def slice(self, lo: int, hi: int) -> "PackedSqrtKeys":
+        return PackedSqrtKeys(self.seeds[lo:hi], self.cw1[lo:hi],
+                              self.cw2[lo:hi], self.n)
+
+    def pad_to(self, size: int) -> "PackedSqrtKeys":
+        """Pad the batch axis to ``size`` by repeating the last key (the
+        same padding rule the logn paths use; pad rows are computed and
+        discarded).  No-op when already at least ``size``."""
+        reps = size - self.batch
+        if reps <= 0:
+            return self
+        return PackedSqrtKeys(
+            np.concatenate([self.seeds,
+                            np.repeat(self.seeds[-1:], reps, 0)]),
+            np.concatenate([self.cw1, np.repeat(self.cw1[-1:], reps, 0)]),
+            np.concatenate([self.cw2, np.repeat(self.cw2[-1:], reps, 0)]),
+            self.n)
+
+
+def stack_sqrt_wire_keys(keys) -> np.ndarray:
+    """Key batch (list of flat int32 array-likes, torch tensors
+    included, or one [B, W] array) -> one contiguous [B, W] int32
+    buffer (``keygen.stack_wire_keys`` with the width check lifted —
+    sqrt keys are O(sqrt N)-sized).  Ragged wire lengths can only come
+    from mixed splits and are rejected as such."""
+    from .keygen import stack_wire_keys
+    if len(keys) == 0:
+        raise ValueError("empty key batch")
+    try:
+        return stack_wire_keys(keys, words=None)
+    except ValueError:
+        raise ValueError("keys for mixed sqrt-N splits") from None
+
+
+def decode_sqrt_keys_batched(keys) -> PackedSqrtKeys:
+    """Vectorized wire -> packed-arrays codec for a uniform sqrt-N key
+    batch.
+
+    Replaces the per-key ``deserialize_sqrt_key`` + ``pack_sqrt_keys``
+    host loop on the hot path: the wire words are stacked once and every
+    seed/codeword limb is decoded with views and reshapes — O(1) Python
+    ops after the stack.  Bit-identical to the scalar codec (asserted in
+    tests/test_key_codec.py), which stays the tested oracle.
+    """
+    arr = stack_sqrt_wire_keys(keys)
+    if arr.shape[1] % 4 or arr.shape[1] < 8:
+        raise ValueError("malformed sqrt-N key: %d int32 words"
+                         % arr.shape[1])
+    slots = arr.view(np.uint32).reshape(arr.shape[0], -1, 4)
+    k = int(slots[0, 0, 0])
+    r = int(slots[0, 1, 0])
+    if ((slots[:, 0, 0] != np.uint32(k)).any()
+            or (slots[:, 1, 0] != np.uint32(r)).any()):
+        raise ValueError("keys for mixed sqrt-N splits")
+    if slots.shape[1] != 4 + k + 2 * r:
+        raise ValueError("malformed sqrt-N key: %d slots for K=%d R=%d"
+                         % (slots.shape[1], k, r))
+    # n <= 2^32 spills into limb 1; limbs 2/3 are zero on every writer
+    n = (slots[:, 2, 0].astype(np.uint64)
+         | (slots[:, 2, 1].astype(np.uint64) << np.uint64(32)))
+    if (n != n[0]).any():
+        raise ValueError("keys for mixed table sizes")
+    if slots[:, 2, 2:].any() or k * r != int(n[0]):
+        raise ValueError("malformed sqrt-N key: n=%d != K*R=%d"
+                         % (int(n[0]), k * r))
+    # seeds/cw1/cw2 are VIEWS into the one stacked buffer: sqrt keys are
+    # O(sqrt N)-big, so a host-side compaction copy would rival the
+    # decode itself — and the device transfer re-lays the bytes anyway
+    return PackedSqrtKeys(
+        seeds=slots[:, 4:4 + k],
+        cw1=slots[:, 4 + k:4 + k + r],
+        cw2=slots[:, 4 + k + r:],
+        n=int(n[0]))
+
+
+# -------------------------------------------------- chunked fused eval
+
+ROW_CHUNK_FLOOR = 4  # the block-PRG 4-row interleave quantum
+
+
+def row_chunk_within_bound(rc: int, k: int, batch: int) -> bool:
+    """True when a [B, rc, K, 4] PRF slab fits the 64 MiB live-seed
+    budget shared with the logn paths (``expand.CHUNK_SEED_BYTES_BOUND``;
+    the 4-row floor is always allowed)."""
+    return rc <= ROW_CHUNK_FLOOR or rc * k * 16 * max(1, batch) <= \
+        CHUNK_SEED_BYTES_BOUND
+
+
+def choose_row_chunk(r: int, k: int, batch: int) -> int:
+    """Grid rows PRF-expanded per scan step: bound the live
+    [B, rc, K, 4] slab at 64 MiB (at N=2^20, B=512 the full grid would
+    be ~8 GiB).  Always a power-of-two multiple of 4 dividing R — the
+    block-PRG ids interleave 4 rows per core block — or R itself when R
+    is too small (or odd-shaped) to chunk."""
+    if r <= ROW_CHUNK_FLOOR or r % ROW_CHUNK_FLOOR:
+        return r
+    target = max(ROW_CHUNK_FLOOR,
+                 CHUNK_SEED_BYTES_BOUND // (16 * k * max(1, batch)))
+    rc = ROW_CHUNK_FLOOR
+    while rc * 2 <= target and r % (rc * 2) == 0 and rc * 2 <= r:
+        rc *= 2
+    return min(rc, r)
+
+
+def clamp_row_chunk(rc, r: int, k: int, batch: int) -> int:
+    """Harden a possibly-tuned ``row_chunk`` against the actual key
+    split and the live-slab budget: tuned entries key on the table
+    shape, not the split, and a nearest-batch fallback can pair a
+    small-batch chunk with a bigger batch.  Falsy or invalid values fall
+    back to the heuristic."""
+    if (not rc or r % int(rc)
+            or (int(rc) < r and int(rc) % ROW_CHUNK_FLOOR)
+            or not row_chunk_within_bound(int(rc), k, batch)):
+        return choose_row_chunk(r, k, batch)
+    return int(rc)
+
+
+def sqrt_chunk_candidates(r: int, k: int, batch: int, span: int = 2) -> list:
+    """``row_chunk`` candidates for the autotuner: powers-of-two
+    multiples of 4 within ``span`` octaves of the ``choose_row_chunk``
+    heuristic, each dividing R and honoring the live-slab bound
+    (candidates above it are dropped, not clipped).  The heuristic
+    itself is always a member, so a tuned config can never regress the
+    static default's memory envelope.  Sorted ascending."""
+    base = choose_row_chunk(r, k, batch)
+    out = {base}
+    for s in range(-span, span + 1):
+        c = base << s if s >= 0 else base >> (-s)
+        if (ROW_CHUNK_FLOOR <= c <= r and r % c == 0
+                and row_chunk_within_bound(c, k, batch)):
+            out.add(c)
+    return sorted(out)
+
+
+@functools.partial(jax.jit, static_argnames=("prf_method", "dot_impl",
+                                             "row_chunk"))
+def _eval_contract_batched_jit(seeds, cw1, cw2, table, *, prf_method,
+                               dot_impl, row_chunk):
     from ..ops import matmul128
 
     bsz, k, _ = seeds.shape
     r = cw1.shape[1]
-    vals = _grid_vals(
-        prf_method,
-        lambda nr: jnp.broadcast_to(seeds[:, None, :, :], (bsz, nr, k, 4)),
-        r, jnp)                                       # [B, R, K, 4]
+    e = table.shape[1]
+    rc = row_chunk
+    steps = r // rc
     sel = (seeds[:, None, :, 0] & np.uint32(1)).astype(bool)[..., None]
-    cw = jnp.where(sel, cw2[:, :, None, :], cw1[:, :, None, :])
-    out = u128.add128(vals, cw)
-    shares = out[..., 0].astype(jnp.int32).reshape(bsz, r * k)
-    return matmul128.dot(shares, table, dot_impl)
 
+    def slab(row0, c1, c2):
+        """One [B, rc, K] grid chunk -> [B, rc*K] int32 leaf shares."""
+        vals = _grid_vals(
+            prf_method,
+            lambda nr: jnp.broadcast_to(seeds[:, None, :, :],
+                                        (bsz, nr, k, 4)),
+            rc, jnp, row0=row0)                       # [B, rc, K, 4]
+        cw = jnp.where(sel, c2[:, :, None, :], c1[:, :, None, :])
+        out = u128.add128(vals, cw)
+        return out[..., 0].astype(jnp.int32).reshape(bsz, rc * k)
 
-_BATCH_JIT = None
+    if steps == 1:  # grid fits the budget — no scan machinery at all
+        return matmul128.dot(slab(np.uint32(0), cw1, cw2), table, dot_impl)
+
+    def body(acc, inp):
+        row0, c1, c2, tbl = inp
+        # int32 adds wrap, so accumulating per-chunk partial dots stays
+        # exact mod 2^32
+        return acc + matmul128.dot(slab(row0, c1, c2), tbl, dot_impl), None
+
+    acc, _ = jax.lax.scan(
+        body, jnp.zeros((bsz, e), jnp.int32),
+        (jnp.arange(steps, dtype=jnp.uint32) * jnp.uint32(rc),
+         jnp.moveaxis(cw1.reshape(bsz, steps, rc, 4), 1, 0),
+         jnp.moveaxis(cw2.reshape(bsz, steps, rc, 4), 1, 0),
+         table.reshape(steps, rc * k, e)))
+    return acc
 
 
 def eval_contract_batched(seeds, cw1, cw2, table, *, prf_method: int,
-                          dot_impl: str = "i32"):
+                          dot_impl: str = "i32",
+                          row_chunk: int | None = None):
     """Fused batched sqrt-N evaluation: one device program for the whole
-    batch — flat [B, R, K] PRF grid, LSB codeword select, 128-bit add,
-    exact mod-2^32 contraction against the natural-order table.
+    batch — row-chunked [B, rc, K] PRF grid slabs scanned over the R
+    rows, LSB codeword select, 128-bit add, exact mod-2^32 contraction
+    against the matching natural-order table rows, accumulated [B, E].
+
+    ``row_chunk`` rows are PRF-expanded per scan step (None = the
+    ``choose_row_chunk`` heuristic), bounding live grid memory at
+    ``expand.CHUNK_SEED_BYTES_BOUND`` instead of the full
+    ``B x N x 16`` bytes; it must divide R and — when actually chunking
+    — be a multiple of 4, so the block-PRG 4-row interleave in
+    ``_grid_vals`` stays intact.
 
     This is the production sqrt-N path (``eval_contract`` keeps the
     per-key stacking for reference use): no level loop, no permutation —
@@ -258,25 +456,28 @@ def eval_contract_batched(seeds, cw1, cw2, table, *, prf_method: int,
     reference's coop kernel plays for single queries,
     ``dpf_gpu/dpf_coop.cu:3-9``).
     """
-    import functools
-    global _BATCH_JIT
-    if _BATCH_JIT is None:
-        import jax
-        _BATCH_JIT = functools.partial(
-            jax.jit, static_argnames=("prf_method", "dot_impl")
-        )(_eval_contract_batched_jit)
-    import jax.numpy as jnp
-    return _BATCH_JIT(jnp.asarray(seeds), jnp.asarray(cw1),
-                      jnp.asarray(cw2), table, prf_method=prf_method,
-                      dot_impl=dot_impl)
+    bsz, k = seeds.shape[0], seeds.shape[1]
+    r = cw1.shape[1]
+    if row_chunk is None:
+        row_chunk = choose_row_chunk(r, k, bsz)
+    row_chunk = int(row_chunk)
+    if row_chunk < 1 or r % row_chunk:
+        raise ValueError("row_chunk (%d) must divide R=%d"
+                         % (row_chunk, r))
+    if row_chunk < r and row_chunk % ROW_CHUNK_FLOOR:
+        raise ValueError(
+            "row_chunk (%d) must be a multiple of 4 when chunking (the "
+            "block-PRG ids interleave 4 rows per core block)" % row_chunk)
+    return _eval_contract_batched_jit(
+        jnp.asarray(seeds), jnp.asarray(cw1), jnp.asarray(cw2), table,
+        prf_method=prf_method, dot_impl=dot_impl, row_chunk=row_chunk)
 
 
-def eval_points_sqrt(keys: list, indices, prf_method: int):
-    """Sparse evaluation at the given indices: [B, Q] int32 shares.
+# ------------------------------------------------------ point evaluation
 
-    Index x = r*K + j costs ONE PRF call (seed j at row r) — the sqrt-N
-    scheme's native strength; no tree walk at all.
-    """
+def eval_points_sqrt_scalar(keys: list, indices, prf_method: int):
+    """Scalar per-(key, index) loop — the tests' parity oracle for
+    ``eval_points_sqrt`` (kept off the hot path on purpose)."""
     idx = np.asarray(indices, dtype=np.int64)
     out = np.zeros((len(keys), idx.size), dtype=np.int32)
     prf = PRF_FUNCS[prf_method]
@@ -288,3 +489,22 @@ def eval_points_sqrt(keys: list, indices, prf_method: int):
             v = (prf(s, r_i) + u128.limbs_to_int(cw)) & MASK128
             out[i, q] = np.int64(v & 0xFFFFFFFF).astype(np.int32)
     return out
+
+
+def eval_points_sqrt(keys: list, indices, prf_method: int):
+    """Sparse evaluation at the given indices: [B, Q] int32 shares.
+
+    Index x = r*K + j costs ONE PRF call (seed j at row r) — the sqrt-N
+    scheme's native strength; no tree walk at all.  The whole [B, Q]
+    query block runs as a single vectorized PRF call over the gathered
+    (seed, row) pairs (``eval_points_sqrt_scalar`` is the scalar
+    oracle)."""
+    idx = np.asarray(indices, dtype=np.int64).reshape(-1)
+    seeds, cw1, cw2 = pack_sqrt_keys(keys)
+    k = keys[0].n_keys
+    rows = (idx // k).astype(np.uint32)               # [Q]
+    sel_seeds = seeds[:, idx % k]                     # [B, Q, 4]
+    vals = prf_v(prf_method, sel_seeds, rows)         # rows broadcast
+    lsb = (sel_seeds[..., 0] & np.uint32(1)).astype(bool)[..., None]
+    cw = np.where(lsb, cw2[:, rows], cw1[:, rows])    # [B, Q, 4]
+    return u128.add128(vals, cw)[..., 0].astype(np.int32)

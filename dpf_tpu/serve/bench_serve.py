@@ -64,6 +64,46 @@ def ingest_microbench(B=512, n=65536, distinct=32, reps=5):
             "speedup": round(scalar_s / batched_s, 2)}
 
 
+def sqrt_ingest_microbench(B=512, n=65536, distinct=32, reps=5):
+    """Scalar per-key sqrt-N codec loop vs the batched codec
+    (``sqrtn.decode_sqrt_keys_batched``) on one key batch — the sqrt-N
+    counterpart of ``ingest_microbench``, same record shape; asserted
+    bit-identical before timing."""
+    from ..core import sqrtn
+
+    ks = []
+    for i in range(distinct):
+        k0, _ = sqrtn.generate_sqrt_keys((i * 0x9E3779B1) % n, n,
+                                         b"sq-ingest-%d" % i, prf_method=0)
+        ks.append(k0.serialize())
+    keys = [ks[i % distinct] for i in range(B)]
+
+    scalar = sqrtn.pack_sqrt_keys([sqrtn.deserialize_sqrt_key(k)
+                                   for k in keys])
+    pk = sqrtn.decode_sqrt_keys_batched(keys)
+    assert (np.array_equal(scalar[0], pk.seeds)
+            and np.array_equal(scalar[1], pk.cw1)
+            and np.array_equal(scalar[2], pk.cw2)
+            and pk.n == n), \
+        "batched sqrt-N codec diverged from the scalar oracle"
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sqrtn.pack_sqrt_keys([sqrtn.deserialize_sqrt_key(k)
+                              for k in keys])
+    scalar_s = (time.perf_counter() - t0) / reps
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        sqrtn.decode_sqrt_keys_batched(keys)
+    batched_s = (time.perf_counter() - t0) / reps
+
+    return {"batch": B, "entries": n, "reps": reps,
+            "scalar_s": round(scalar_s, 6),
+            "batched_s": round(batched_s, 6),
+            "speedup": round(scalar_s / batched_s, 2)}
+
+
 def _key_stream(dpf, n, batch, batches, distinct=16, ragged=False):
     """A deterministic stream of key batches (server-0 keys)."""
     ks = [dpf.gen((i * 0x9E3779B1) % n, n, seed=b"serve-%d" % i)[0]

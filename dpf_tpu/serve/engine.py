@@ -22,10 +22,12 @@ arXiv:2112.09017).  The engine splits that pipeline:
   them at init.
 
 The engine is server-agnostic: any object with ``_decode_batch(keys) ->
-PackedKeys`` and ``_dispatch_packed(pk) -> device array`` works — both
-``api.DPF`` (single chip) and ``parallel.sharded.ShardedDPFServer``
-(mesh path) provide the pair.  Results are bit-identical to the blocking
-loop (pad rows are discarded; per-key math is batch-shape independent).
+packed batch`` and ``_dispatch_packed(pk) -> device array`` works — both
+``api.DPF`` (single chip, all three constructions: binary GGM, radix-4,
+and sqrt-N via ``sqrtn.PackedSqrtKeys``) and
+``parallel.sharded.ShardedDPFServer`` (mesh path) provide the pair.
+Results are bit-identical to the blocking loop (pad rows are discarded;
+per-key math is batch-shape independent).
 """
 
 from __future__ import annotations
@@ -98,10 +100,6 @@ class ServingEngine:
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1 (got %d)"
                              % max_in_flight)
-        if getattr(server, "scheme", "logn") == "sqrtn":
-            raise NotImplementedError(
-                "ServingEngine supports the logn schemes (binary and "
-                "radix-4); sqrtn keys have no packed-batch codec yet")
         n = getattr(server, "table_num_entries", None)
         if n is None:
             n = getattr(server, "n", None)
@@ -233,10 +231,13 @@ class ServingEngine:
     def warmup(self, tune: bool = False, trace=None) -> None:
         """Precompile every bucket's program with synthetic keys.
 
-        A zero-codeword key with a valid header (depth/n) decodes into
-        the exact array shapes real traffic produces, so each dispatch
-        here populates the jit cache for one bucket size; outputs are
-        discarded and none of the serving counters move.
+        A zero-codeword key with a valid header (depth/n — or, for
+        scheme='sqrtn', the default K x R split) decodes into the exact
+        array shapes real traffic produces, so each dispatch here
+        populates the jit cache for one bucket size; outputs are
+        discarded and none of the serving counters move.  (Sqrt-N keys
+        minted with a custom ``n_keys`` split compile their own program
+        on first dispatch — only the default split is prewarmed.)
 
         ``tune=True`` first re-tunes the serving knobs in place: the
         persistent tuning cache (``tune/cache.py``) is consulted for
@@ -260,12 +261,25 @@ class ServingEngine:
                 self.max_in_flight = int(knobs["max_in_flight"])
         from ..core.keygen import PackedKeys
         depth = self._n.bit_length() - 1
+        sqrt_split = None
+        if getattr(self._server, "scheme", "logn") == "sqrtn":
+            from ..core import sqrtn
+            sqrt_split = sqrtn.default_split(self._n)
         for size in self.buckets.sizes:
-            pk = PackedKeys(
-                cw1=np.zeros((size, 64, 4), dtype=np.uint32),
-                cw2=np.zeros((size, 64, 4), dtype=np.uint32),
-                last=np.zeros((size, 4), dtype=np.uint32),
-                depth=depth, n=self._n)
+            if sqrt_split is not None:
+                from ..core.sqrtn import PackedSqrtKeys
+                k, r = sqrt_split
+                pk = PackedSqrtKeys(
+                    seeds=np.zeros((size, k, 4), dtype=np.uint32),
+                    cw1=np.zeros((size, r, 4), dtype=np.uint32),
+                    cw2=np.zeros((size, r, 4), dtype=np.uint32),
+                    n=self._n)
+            else:
+                pk = PackedKeys(
+                    cw1=np.zeros((size, 64, 4), dtype=np.uint32),
+                    cw2=np.zeros((size, 64, 4), dtype=np.uint32),
+                    last=np.zeros((size, 4), dtype=np.uint32),
+                    depth=depth, n=self._n)
             np.asarray(self._server._dispatch_packed(pk))
 
     # ------------------------------------------------------------ plumbing

@@ -8,16 +8,20 @@ ladder and ``max_in_flight`` for the serving engine
 (``serve_tune.tune_serving``) — are searched by staged coordinate
 descent, every timed candidate equality-gated against the scalar
 oracle, and the winners persisted in a JSON cache keyed by device
-fingerprint x shape (``cache``/``fingerprint``).  ``compcache`` wires
-JAX's persistent compilation cache alongside, so tuned programs also
-skip the XLA recompile across processes.  See docs/TUNING.md.
+fingerprint x shape (``cache``/``fingerprint``).  ``search.scheme_sweep``
+goes one level up and races the three constructions (logn, radix-4,
+sqrtn) per shape, so the cache can also answer "which construction"
+(``cache.lookup_scheme``).  ``compcache`` wires JAX's persistent
+compilation cache alongside, so tuned programs also skip the XLA
+recompile across processes.  See docs/TUNING.md.
 """
 
 from .cache import (  # noqa: F401
-    TuningCache, default_cache, lookup_eval_knobs)
+    TuningCache, default_cache, lookup_eval_knobs, lookup_scheme)
 from .compcache import enable as enable_compilation_cache  # noqa: F401
 from .fingerprint import cache_key, device_fingerprint  # noqa: F401
 from .search import (  # noqa: F401
-    autotune_sweep, heuristic_knobs, stage_candidates, tune_eval)
+    autotune_sweep, heuristic_knobs, scheme_sweep, stage_candidates,
+    tune_eval)
 from .serve_tune import (  # noqa: F401
     lookup_serve_knobs, synthetic_trace, tune_serving)

@@ -185,3 +185,17 @@ def lookup_eval_knobs(*, n: int, entry_size: int, batch: int,
             batch=batch, prf_method=prf_method, scheme=scheme, radix=radix)
     except Exception:  # pragma: no cover — cache must never break serving
         return None
+
+
+def lookup_scheme(*, n: int, entry_size: int, batch: int,
+                  prf_method: int) -> dict | None:
+    """The measured winning construction for this shape on this machine
+    (``{"scheme": ..., "radix": ..., "construction": ...}``), recorded
+    by ``benchmark.py --autotune-scheme`` (``search.scheme_sweep``);
+    nearest-batch fallback like the eval-knob lookup.  Never raises."""
+    try:
+        return default_cache().lookup_knobs(
+            "scheme", nearest_batch=True, n=n, entry_size=entry_size,
+            batch=batch, prf_method=prf_method, scheme="any", radix=0)
+    except Exception:  # pragma: no cover — cache must never break serving
+        return None

@@ -14,7 +14,10 @@ has two halves:
   family.
 
 ``cache_key(kind, ...)`` joins both under a ``kind`` tag ("eval" for the
-fused-eval knobs, "serve" for the engine's ladder/in-flight knobs).
+fused-eval knobs, "serve" for the engine's ladder/in-flight knobs,
+"scheme" for the scheme-level winner — there scheme/radix are the
+entry's ANSWER, not its shape, so the key pins them to the ``any``/0
+sentinels; see ``search.scheme_cache_key``).
 """
 
 from __future__ import annotations

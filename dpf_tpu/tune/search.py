@@ -42,11 +42,22 @@ from .fingerprint import cache_key, device_fingerprint
 STAGES = ("chunk_leaves", "dot_impl", "kernel_impl", "dispatch_group",
           "aes_impl")
 
+#: the sqrt-N program has exactly two knobs: the scan's row chunk (its
+#: memory shape) and the contraction backend
+SQRT_STAGES = ("row_chunk", "dot_impl")
+
 
 def heuristic_knobs(n: int, batch: int, *, prf_method: int,
-                    radix: int = 2) -> dict:
+                    radix: int = 2, scheme: str = "logn") -> dict:
     """The static-heuristic knob set (what an untuned process runs)."""
     from ..core import prf as _prf
+    if scheme == "sqrtn":
+        from ..core import sqrtn
+        k, r = sqrtn.default_split(n)
+        return {
+            "row_chunk": sqrtn.choose_row_chunk(r, k, batch),
+            "dot_impl": matmul128.default_impl(),
+        }
     return {
         "chunk_leaves": expand.choose_chunk(n, batch),
         "dot_impl": matmul128.default_impl(),
@@ -68,6 +79,10 @@ def stage_candidates(stage: str, current: dict, *, n: int, batch: int,
     if backend is None:
         import jax
         backend = jax.default_backend()
+    if stage == "row_chunk":  # sqrtn's memory-shape knob
+        from ..core import sqrtn
+        k, r = sqrtn.default_split(n)
+        return sqrtn.sqrt_chunk_candidates(r, k, batch)
     if stage == "chunk_leaves":
         return expand.chunk_candidates(n, batch)
     if stage == "dot_impl":
@@ -116,21 +131,26 @@ def tune_eval(n: int, batch: int, *, entry_size: int = 16,
               prf_method: int = 0, scheme: str = "logn", radix: int = 2,
               reps: int = 3, distinct: int = 32,
               cache: TuningCache | None = None, force: bool = False,
-              stages=STAGES, log=None) -> dict:
+              stages=None, log=None) -> dict:
     """Tune the fused-eval knobs for one (N, E, B, prf, scheme, radix).
 
+    ``stages=None`` picks the scheme's own coordinate-descent order
+    (``STAGES`` for the logn constructions, ``SQRT_STAGES`` for sqrtn).
     Returns the cache record (knobs + measurements) with a transient
     ``searched`` field: False when a warm cache answered and no program
     ran.  ``force=True`` re-measures and overwrites.
     """
+    if stages is None:
+        stages = SQRT_STAGES if scheme == "sqrtn" else STAGES
     cache = cache if cache is not None else default_cache()
     from ..core.u128 import next_pow2
-    # key by the PADDED batch: eval_tpu pads every dispatch to the next
-    # power of two, so the program the tuner times — and the batch every
-    # later lookup resolves with — is the pow2 one
-    key = cache_key("eval", n=n, entry_size=entry_size,
-                    batch=next_pow2(batch), prf_method=prf_method,
-                    scheme=scheme, radix=radix)
+    # the PADDED batch: eval_tpu pads every dispatch to the next power
+    # of two, so the program the tuner times — and the batch every
+    # later lookup resolves with, and the one the memory-bound chunk
+    # candidates must be generated against — is the pow2 one
+    pb = next_pow2(batch)
+    key = cache_key("eval", n=n, entry_size=entry_size, batch=pb,
+                    prf_method=prf_method, scheme=scheme, radix=radix)
     if not force:
         rec = cache.lookup(key)
         if rec is not None:
@@ -177,7 +197,8 @@ def tune_eval(n: int, batch: int, *, entry_size: int = 16,
                 log("  reject (%s): %r" % (type(exc).__name__, knobs))
             return None
 
-    current = heuristic_knobs(n, batch, prf_method=prf_method, radix=radix)
+    current = heuristic_knobs(n, pb, prf_method=prf_method,
+                              radix=radix, scheme=scheme)
     heuristic_s = measure(dict(current))
     if heuristic_s is None:
         raise AssertionError(
@@ -187,7 +208,7 @@ def tune_eval(n: int, batch: int, *, entry_size: int = 16,
     best_s = heuristic_s
     timings = {_knob_tag(current): round(heuristic_s, 6)}
     for stage in stages:
-        cands = stage_candidates(stage, current, n=n, batch=batch,
+        cands = stage_candidates(stage, current, n=n, batch=pb,
                                  prf_method=prf_method, radix=radix)
         for cand in cands:
             if cand == current.get(stage):
@@ -204,8 +225,8 @@ def tune_eval(n: int, batch: int, *, entry_size: int = 16,
 
     record = {
         "knobs": current,
-        "heuristic": heuristic_knobs(n, batch, prf_method=prf_method,
-                                     radix=radix),
+        "heuristic": heuristic_knobs(n, pb, prf_method=prf_method,
+                                     radix=radix, scheme=scheme),
         "measured": {
             "best_s": round(best_s, 6),
             "heuristic_s": round(heuristic_s, 6),
@@ -224,6 +245,8 @@ def tune_eval(n: int, batch: int, *, entry_size: int = 16,
 
 
 def _knob_tag(knobs: dict) -> str:
+    if "row_chunk" in knobs:  # the sqrtn knob space
+        return "rc%s.%s" % (knobs.get("row_chunk"), knobs.get("dot_impl"))
     return "c%s.%s.%s.g%s.%s" % (
         knobs.get("chunk_leaves"), knobs.get("dot_impl"),
         knobs.get("kernel_impl"), knobs.get("dispatch_group"),
@@ -287,6 +310,111 @@ def autotune_sweep(shapes=DEFAULT_SWEEP, *, prf_method: int = 0,
         "prf": PRF_NAMES[prf_method],
         "eval_points": points,
         "serve": serve_rec,
+        "tuning_cache": cache.path,
+        "compilation_cache": compcache.enabled_dir(),
+        "cache_counters": CACHE_COUNTERS.as_dict(),
+        "checked": True,  # every timed candidate passed the oracle gate
+    }
+    if not quiet:
+        print(json.dumps(record), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    return record
+
+
+# -------------------------------------------------------- scheme sweep
+
+#: the constructions the scheme-level sweep races per (N, E, B, prf):
+#: (scheme, radix, label) — "radix4" is spelled scheme="logn", radix=4
+CONSTRUCTIONS = (("logn", 2, "logn"), ("logn", 4, "radix4"),
+                 ("sqrtn", 2, "sqrtn"))
+
+
+def scheme_cache_key(*, n: int, entry_size: int, batch: int,
+                     prf_method: int) -> str:
+    """Tuning-cache key for the scheme-level winner.  scheme/radix are
+    the ANSWER of this entry, not part of its shape, so the key pins
+    them to the ``any``/0 sentinels (``fingerprint.cache_key`` keeps
+    one key grammar for all kinds)."""
+    return cache_key("scheme", n=n, entry_size=entry_size, batch=batch,
+                     prf_method=prf_method, scheme="any", radix=0)
+
+
+def scheme_sweep(shapes=DEFAULT_SWEEP, *, prf_method: int = 0,
+                 entry_size: int = 16, reps: int = 3,
+                 force: bool = False, cache: TuningCache | None = None,
+                 out: str | None = None, quiet: bool = False) -> dict:
+    """``benchmark.py --autotune-scheme``: the tuner answers "which
+    construction", not just "which knobs" (the ROADMAP "sqrtn scheme
+    sweep" item; per-shape construction search is the AlphaEvolve
+    TPU-FHE move, PAPERS.md arXiv:2605.14718).
+
+    Races the three constructions — binary GGM, radix-4, sqrt-N — per
+    (N, B) point.  Each is first knob-tuned by ``tune_eval`` (so every
+    timed candidate passed the scalar-oracle equality gate and tuned <=
+    heuristic seconds by construction), then the best tuned time picks
+    the winner, persisted in the tuning cache under the ``scheme|...``
+    key (``tune.cache.lookup_scheme`` answers later processes).  Also
+    measures the sqrt-N batched-ingest codec against the scalar decode
+    loop.  The CPU record is committed as ``BENCH_SCHEME_r08.json``.
+    """
+    compcache.enable()
+    cache = cache if cache is not None else default_cache()
+    log = None if quiet else (lambda m: print(m, flush=True))
+    from ..core.u128 import next_pow2
+    points = []
+    for n, batch in shapes:
+        rows = []
+        for scheme, radix, label in CONSTRUCTIONS:
+            if log:
+                log("tuning %s at n=%d batch=%d prf=%s ..."
+                    % (label, n, batch, PRF_NAMES[prf_method]))
+            rec = tune_eval(n, batch, entry_size=entry_size,
+                            prf_method=prf_method, scheme=scheme,
+                            radix=radix, reps=reps, cache=cache,
+                            force=force, log=log)
+            m = rec["measured"]
+            rows.append({
+                "construction": label, "scheme": scheme, "radix": radix,
+                "tuned_knobs": rec["knobs"],
+                "tuned_s": m["best_s"], "heuristic_s": m["heuristic_s"],
+                "speedup_vs_heuristic": m["speedup_vs_heuristic"],
+                "tuned_qps": int(batch / m["best_s"]),
+                "candidates_tried": m["candidates_tried"],
+                "rejected": m["rejected"],
+                "from_cache": not rec["searched"],
+            })
+        win = min(rows, key=lambda r: r["tuned_s"])
+        if log:
+            log("winner at n=%d batch=%d: %s (%d qps)"
+                % (n, batch, win["construction"], win["tuned_qps"]))
+        cache.store(
+            scheme_cache_key(n=n, entry_size=entry_size,
+                             batch=next_pow2(batch),
+                             prf_method=prf_method),
+            {"knobs": {"scheme": win["scheme"], "radix": win["radix"],
+                       "construction": win["construction"]},
+             "measured": {"per_construction": rows, "entries": n,
+                          "batch": batch, "entry_size": entry_size,
+                          "prf": PRF_NAMES[prf_method], "reps": reps},
+             "fingerprint": device_fingerprint(),
+             "gated": True})
+        points.append({"entries": n, "batch": batch,
+                       "winner": win["construction"],
+                       "winner_qps": win["tuned_qps"],
+                       "constructions": rows})
+    from ..serve.bench_serve import sqrt_ingest_microbench
+    n_mb, b_mb = max(shapes, key=lambda s: s[0] * s[1])
+    micro = sqrt_ingest_microbench(B=b_mb, n=n_mb)
+    record = {
+        "metric": "scheme-level autotune: logn vs radix-4 vs sqrtn per "
+                  "(N, B), equality-gated, best-of-%d reps" % reps,
+        "fingerprint": device_fingerprint(),
+        "prf": PRF_NAMES[prf_method],
+        "points": points,
+        "sqrt_ingest_microbench": micro,
         "tuning_cache": cache.path,
         "compilation_cache": compcache.enabled_dir(),
         "cache_counters": CACHE_COUNTERS.as_dict(),

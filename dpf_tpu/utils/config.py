@@ -47,6 +47,10 @@ class EvalConfig:
     scheme: str = "logn"  # "logn" (GGM tree, O(log N) keys) | "sqrtn"
     #                 (core/sqrtn.py: O(sqrt N) keys, flat single-level PRF
     #                 grid — the latency play for mid-sized tables)
+    row_chunk: int | None = None  # sqrtn: grid rows PRF-expanded per scan
+    #                 step (None = auto: tuned, else sqrtn.choose_row_chunk
+    #                 bounding the live [B, rc, K, 4] slab at the 64 MiB
+    #                 CHUNK_SEED_BYTES_BOUND); multiple of 4, divides R
 
     def with_(self, **kw) -> "EvalConfig":
         return replace(self, **kw)

@@ -1,13 +1,14 @@
 """Batched wire-codec equivalence: the vectorized ingest
-(``keygen.decode_keys_batched`` / ``radix4.decode_mixed_keys_batched``)
-must be bit-identical to the scalar codec (``deserialize_key`` +
-``pack_keys``), which stays as the oracle — binary and radix-4 wire
-formats, fuzzed over (n, alpha, seed)."""
+(``keygen.decode_keys_batched`` / ``radix4.decode_mixed_keys_batched`` /
+``sqrtn.decode_sqrt_keys_batched``) must be bit-identical to the scalar
+codec (``deserialize_key`` + ``pack_keys`` and the sqrt-N counterparts),
+which stays as the oracle — binary, radix-4, and sqrt-N wire formats,
+fuzzed over (n, alpha, seed)."""
 
 import numpy as np
 import pytest
 
-from dpf_tpu.core import expand, keygen, radix4
+from dpf_tpu.core import expand, keygen, radix4, sqrtn
 
 
 def _binary_batch(n, batch, seed=0):
@@ -116,3 +117,91 @@ def test_pad_to_repeats_last_key():
         assert np.array_equal(padded.cw1[i], pk.cw1[-1])
         assert np.array_equal(padded.last[i], pk.last[-1])
     assert padded.pad_to(4) is padded  # no-op when already larger
+
+
+# ------------------------------------------------------------ sqrt-N codec
+
+
+def _sqrt_batch(n, batch, seed=0, n_keys=None):
+    rng = np.random.default_rng(seed)
+    keys = []
+    for i in range(batch):
+        k0, k1 = sqrtn.generate_sqrt_keys(int(rng.integers(0, n)), n,
+                                          b"codecS-%d-%d" % (seed, i),
+                                          prf_method=0, n_keys=n_keys)
+        keys.append((k0 if i % 2 else k1).serialize())
+    return keys
+
+
+@pytest.mark.parametrize("n", [4, 256, 4096])
+@pytest.mark.parametrize("batch", [1, 3, 17])
+def test_sqrt_batched_equals_scalar(n, batch):
+    keys = _sqrt_batch(n, batch, seed=n + batch)
+    sk = [sqrtn.deserialize_sqrt_key(k) for k in keys]
+    seeds, cw1, cw2 = sqrtn.pack_sqrt_keys(sk)
+    pk = sqrtn.decode_sqrt_keys_batched(keys)
+    assert np.array_equal(pk.seeds, seeds)
+    assert np.array_equal(pk.cw1, cw1)
+    assert np.array_equal(pk.cw2, cw2)
+    assert (pk.n, pk.n_keys, pk.n_codewords) == \
+        (sk[0].n, sk[0].n_keys, sk[0].n_codewords)
+    assert pk.seeds.dtype == np.uint32 and pk.cw1.dtype == np.uint32
+
+
+def test_sqrt_fuzz_roundtrip():
+    """Fuzzed serialize -> batched decode -> re-serialize bit-exactness
+    (custom splits included)."""
+    rng = np.random.default_rng(9)
+    for trial in range(8):
+        d = int(rng.integers(2, 13))
+        n = 1 << d
+        n_keys = 1 << int(rng.integers(1, d))
+        keys = _sqrt_batch(n, int(rng.integers(1, 9)), seed=trial,
+                           n_keys=n_keys)
+        pk = sqrtn.decode_sqrt_keys_batched(keys)
+        for i, wire in enumerate(keys):
+            back = sqrtn.SqrtKey(n_keys=pk.n_keys,
+                                 n_codewords=pk.n_codewords, n=pk.n,
+                                 keys=np.asarray(pk.seeds[i]),
+                                 cw1=np.asarray(pk.cw1[i]),
+                                 cw2=np.asarray(pk.cw2[i]))
+            assert np.array_equal(back.serialize(), np.asarray(wire))
+
+
+def test_sqrt_codec_rejects_malformed_and_mixed():
+    keys = _sqrt_batch(256, 2)
+    # truncated wire (malformed length)
+    with pytest.raises(ValueError, match="malformed|mixed"):
+        sqrtn.decode_sqrt_keys_batched([keys[0], keys[1][:-4]])
+    with pytest.raises(ValueError, match="malformed"):
+        sqrtn.decode_sqrt_keys_batched([keys[0][:-3], keys[1][:-3]])
+    # mixed table sizes decode to different wire lengths
+    with pytest.raises(ValueError, match="mixed"):
+        sqrtn.decode_sqrt_keys_batched(keys + _sqrt_batch(1024, 1))
+    # SAME wire length, different split: n=256 @ K=32 (4+32+16 slots)
+    # vs n=256 @ K=16 (4+16+32 slots) — headers must catch it
+    same_len = _sqrt_batch(256, 1, seed=3, n_keys=32)
+    assert len(np.asarray(same_len[0])) == len(np.asarray(keys[0]))
+    with pytest.raises(ValueError, match="mixed sqrt-N splits"):
+        sqrtn.decode_sqrt_keys_batched([keys[0], same_len[0]])
+    # corrupt n slot (inconsistent with K*R)
+    bad = np.array(keys[0], copy=True)
+    bad[8] = 513
+    with pytest.raises(ValueError, match="malformed"):
+        sqrtn.decode_sqrt_keys_batched([bad])
+    with pytest.raises(ValueError, match="empty"):
+        sqrtn.decode_sqrt_keys_batched([])
+
+
+def test_sqrt_pad_and_slice():
+    keys = _sqrt_batch(256, 3)
+    pk = sqrtn.decode_sqrt_keys_batched(keys)
+    padded = pk.pad_to(8)
+    assert padded.batch == 8 and padded.n == pk.n
+    assert np.array_equal(padded.seeds[:3], pk.seeds)
+    for i in range(3, 8):
+        assert np.array_equal(padded.seeds[i], pk.seeds[-1])
+        assert np.array_equal(padded.cw2[i], pk.cw2[-1])
+    assert padded.pad_to(4) is padded  # no-op when already larger
+    sl = pk.slice(1, 3)
+    assert sl.batch == 2 and np.array_equal(sl.cw1, pk.cw1[1:3])

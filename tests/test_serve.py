@@ -218,10 +218,29 @@ def test_engine_requires_initialized_table():
         ServingEngine(DPF(prf=DPF.PRF_DUMMY))
 
 
-def test_engine_rejects_sqrtn():
-    dpf = DPF(config=EvalConfig(prf_method=0, scheme="sqrtn"))
-    with pytest.raises(NotImplementedError):
-        ServingEngine(dpf)
+def test_engine_sqrtn_end_to_end():
+    """The engine serves all three constructions: a sqrt-N server —
+    packed via sqrtn.decode_sqrt_keys_batched and dispatched through
+    the chunked fused grid — is bit-identical to its blocking eval_tpu
+    loop, warmup and ragged buckets included."""
+    n, entry = 512, 7
+    dpf = DPF(prf=DPF.PRF_CHACHA20, scheme="sqrtn")
+    table = np.random.default_rng(23).integers(
+        -2 ** 31, 2 ** 31, (n, entry), dtype=np.int64).astype(np.int32)
+    dpf.eval_init(table)
+    keys = [dpf.gen((i * 37) % n, n, seed=b"sq%d" % i)[0]
+            for i in range(13)]
+    engine = dpf.serving_engine(buckets=(4, 8), max_in_flight=2,
+                                warmup=True)
+    assert engine.stats.batches_submitted == 0  # warmup doesn't count
+    stream = [keys[:8], keys[8:13], keys[3:4]]
+    futs = [engine.submit(b) for b in stream]
+    engine.drain()
+    for b, fut in zip(stream, futs):
+        assert np.array_equal(fut.result(), np.asarray(dpf.eval_tpu(b)))
+    # and the engine's resolved config reports the sqrtn knob space
+    rc = engine.resolved_config()
+    assert "row_chunk" in rc and rc["buckets"] == [4, 8]
 
 
 # ---------------------------------------------------------- sharded path

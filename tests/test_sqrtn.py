@@ -120,3 +120,129 @@ def test_sqrt_rejects_bad_args():
         sqrtn.generate_sqrt_keys(64, 64, b"x", prf_ref.PRF_DUMMY)
     with pytest.raises(ValueError):
         sqrtn.generate_sqrt_keys(0, 64, b"x", prf_ref.PRF_DUMMY, n_keys=3)
+
+
+# ------------------------------------------------------ chunked fused eval
+
+
+@pytest.mark.parametrize("prf_method", [prf_ref.PRF_DUMMY,
+                                        prf_ref.PRF_SALSA20,
+                                        prf_ref.PRF_CHACHA20,
+                                        prf_ref.PRF_AES128,
+                                        prf_ref.PRF_SALSA20_BLK,
+                                        prf_ref.PRF_CHACHA20_BLK])
+def test_sqrt_chunked_matches_unchunked(prf_method):
+    """Every row_chunk (including the block-PRG ids, whose 4-row
+    interleave is the easy thing to break) is bit-identical to the
+    single-chunk program AND to the host grid oracle."""
+    import jax.numpy as jnp
+
+    n, e = 256, 5
+    table = np.random.default_rng(7).integers(
+        -2 ** 31, 2 ** 31, (n, e), dtype=np.int64).astype(np.int32)
+    pairs = [sqrtn.generate_sqrt_keys((i * 71 + 3) % n, n, b"ch%d" % i,
+                                      prf_method) for i in range(2)]
+    keys = [p[0] for p in pairs] + [pairs[0][1]]
+    seeds, cw1, cw2 = sqrtn.pack_sqrt_keys(keys)
+    r = keys[0].n_codewords
+    hots = np.stack([sqrtn.eval_grid(kk, prf_method) for kk in keys])
+    oracle = (hots.astype(np.uint32) @ table.view(np.uint32)).view(np.int32)
+    for rc in (4, 8, r):
+        out = np.asarray(sqrtn.eval_contract_batched(
+            seeds, cw1, cw2, jnp.asarray(table), prf_method=prf_method,
+            dot_impl="i32", row_chunk=rc))
+        assert np.array_equal(out, oracle), (prf_method, rc)
+
+
+def test_sqrt_row_chunk_rejects_bad():
+    import jax.numpy as jnp
+
+    n = 256
+    k0, _ = sqrtn.generate_sqrt_keys(3, n, b"rc", prf_ref.PRF_DUMMY)
+    seeds, cw1, cw2 = sqrtn.pack_sqrt_keys([k0])
+    table = jnp.zeros((n, 2), jnp.int32)
+    with pytest.raises(ValueError, match="divide"):
+        sqrtn.eval_contract_batched(seeds, cw1, cw2, table,
+                                    prf_method=0, row_chunk=3)
+    with pytest.raises(ValueError, match="multiple of 4"):
+        sqrtn.eval_contract_batched(seeds, cw1, cw2, table,
+                                    prf_method=0, row_chunk=2)
+
+
+def test_sqrt_row_chunk_properties_fuzzed():
+    """choose_row_chunk / sqrt_chunk_candidates honor the shared live
+    memory budget (expand.CHUNK_SEED_BYTES_BOUND): every value divides
+    R, is a multiple of 4 whenever it actually chunks, stays within the
+    bound (above the always-allowed 4-row floor), and the heuristic is
+    always a candidate."""
+    from dpf_tpu.core.expand import CHUNK_SEED_BYTES_BOUND
+    rng = np.random.default_rng(44)
+    for _ in range(300):
+        n = 1 << int(rng.integers(7, 23))
+        k = 1 << int(rng.integers(1, n.bit_length() - 1))
+        r = n // k
+        batch = int(rng.integers(1, 2049))
+        rc = sqrtn.choose_row_chunk(r, k, batch)
+        assert r % rc == 0 and (rc == r or rc % 4 == 0), (r, k, batch, rc)
+        assert (rc <= sqrtn.ROW_CHUNK_FLOOR or rc == r
+                or rc * k * 16 * batch <= CHUNK_SEED_BYTES_BOUND), \
+            (r, k, batch, rc)
+        cands = sqrtn.sqrt_chunk_candidates(r, k, batch)
+        assert rc in cands
+        for c in cands:
+            assert r % c == 0 and (c == r or c % 4 == 0), (r, k, batch, c)
+        # clamp: an invalid tuned value falls back to the heuristic
+        assert sqrtn.clamp_row_chunk(None, r, k, batch) == rc
+        assert sqrtn.clamp_row_chunk(3, r, k, batch) in (3, rc)
+        assert r % sqrtn.clamp_row_chunk(8 * r, r, k, batch) == 0
+
+
+def test_sqrt_bounded_memory_large_grid():
+    """Acceptance: N=2^18 at B=512 — the full [B, N] PRF grid would be
+    2 GiB live — runs through the chunked path with the per-step slab
+    provably within expand.CHUNK_SEED_BYTES_BOUND, bit-identical to the
+    scalar grid oracle."""
+    from dpf_tpu.core.expand import CHUNK_SEED_BYTES_BOUND
+
+    import dpf_tpu
+
+    n, batch, e, distinct = 1 << 18, 512, 2, 4
+    d = dpf_tpu.DPF(prf=dpf_tpu.PRF_DUMMY, scheme="sqrtn")
+    table = np.random.default_rng(18).integers(
+        0, 2 ** 31, (n, e), dtype=np.int32, endpoint=False)
+    d.eval_init(table)
+    ks = [d.gen((i * 0x9E3779B1) % n, n, seed=b"big%d" % i)[0]
+          for i in range(distinct)]
+    keys = [ks[i % distinct] for i in range(batch)]
+
+    k_split, r_split = sqrtn.default_split(n)
+    rc = sqrtn.choose_row_chunk(r_split, k_split, batch)
+    assert batch * n * 16 >= (1 << 31)          # unchunked grid: 2 GiB
+    assert batch * rc * k_split * 16 <= CHUNK_SEED_BYTES_BOUND
+    assert rc < r_split                         # chunking actually engaged
+
+    out = np.asarray(d.eval_tpu(keys))
+    hots = np.stack([sqrtn.eval_grid(kk, d.prf_method)
+                     for kk in d._sqrt_batch(ks)])
+    oracle = (hots.astype(np.uint32) @ table.view(np.uint32)).view(np.int32)
+    assert np.array_equal(out, oracle[[i % distinct for i in range(batch)]])
+
+
+# ------------------------------------------------------- point evaluation
+
+
+@pytest.mark.parametrize("prf_method", [prf_ref.PRF_DUMMY,
+                                        prf_ref.PRF_CHACHA20,
+                                        prf_ref.PRF_SALSA20_BLK])
+def test_sqrt_eval_points_vectorized_matches_scalar(prf_method):
+    """The one-batched-PRF-call eval_points_sqrt is bit-identical to the
+    scalar per-(key, index) loop (the kept oracle)."""
+    n, alpha = 256, 77
+    pairs = [sqrtn.generate_sqrt_keys(alpha, n, b"pt%d" % i, prf_method)
+             for i in range(2)]
+    keys = [p[i % 2] for i, p in enumerate(pairs)]
+    idx = [0, 1, alpha - 1, alpha, alpha + 1, n - 1, alpha]
+    got = sqrtn.eval_points_sqrt(keys, idx, prf_method)
+    want = sqrtn.eval_points_sqrt_scalar(keys, idx, prf_method)
+    assert got.shape == (2, len(idx)) and got.dtype == np.int32
+    assert np.array_equal(got, want)

@@ -84,6 +84,61 @@ def test_sqrtn_rejects_radix4_and_bad_scheme():
         dpf_tpu.DPF(config=EvalConfig(scheme="cube"))
 
 
+def test_scheme_direct_constructor_argument():
+    """DPF(scheme="sqrtn") without an EvalConfig: same keys, same
+    shares as the config spelling — and the validation is shared (bad
+    values and config conflicts are rejected in the same place)."""
+    n = 128
+    d = dpf_tpu.DPF(prf=dpf_tpu.PRF_CHACHA20, scheme="sqrtn")
+    assert d.scheme == "sqrtn"
+    table = np.arange(n * 4, dtype=np.int32).reshape(n, 4)
+    d.eval_init(table)
+    k0, k1 = d.gen(9, n, seed=b"direct")
+    cfg = _pair()
+    cfg.eval_init(table)
+    c0, c1 = cfg.gen(9, n, seed=b"direct")
+    assert np.array_equal(np.asarray(k0), np.asarray(c0))
+    assert np.array_equal(np.asarray(d.eval_tpu([k0, k1])),
+                          np.asarray(cfg.eval_tpu([c0, c1])))
+    # agreement when both spellings are given; a knob-only config (its
+    # scheme left at the "logn" default) composes with the direct arg
+    both = dpf_tpu.DPF(config=EvalConfig(scheme="sqrtn"), scheme="sqrtn")
+    assert both.scheme == "sqrtn"
+    knob_only = dpf_tpu.DPF(config=EvalConfig(row_chunk=4),
+                            scheme="sqrtn")
+    assert knob_only.scheme == "sqrtn"
+    assert knob_only._config.row_chunk == 4
+    # a config pinned to the OTHER non-default construction conflicts
+    with pytest.raises(ValueError, match="conflicts"):
+        dpf_tpu.DPF(config=EvalConfig(scheme="sqrtn"), scheme="logn")
+    with pytest.raises(ValueError, match="scheme"):
+        dpf_tpu.DPF(scheme="cube")
+
+
+def test_sqrtn_explicit_row_chunk_config():
+    """An explicit EvalConfig.row_chunk wins over auto resolution and
+    still produces bit-identical shares."""
+    n = 256
+    auto = _pair()
+    pinned = dpf_tpu.DPF(config=EvalConfig(
+        prf_method=dpf_tpu.PRF_CHACHA20, scheme="sqrtn", row_chunk=4))
+    table = np.random.default_rng(4).integers(
+        -2 ** 31, 2 ** 31, (n, 6), dtype=np.int64).astype(np.int32)
+    auto.eval_init(table)
+    pinned.eval_init(table)
+    assert pinned.resolved_eval_knobs(2)["row_chunk"] == 4
+    k0, k1 = auto.gen(200, n)
+    assert np.array_equal(np.asarray(auto.eval_tpu([k0, k1])),
+                          np.asarray(pinned.eval_tpu([k0, k1])))
+    # an INVALID explicit pin raises (the logn chunk_leaves rule) —
+    # silent heuristic fallback is reserved for tuned values
+    bad = dpf_tpu.DPF(config=EvalConfig(
+        prf_method=dpf_tpu.PRF_CHACHA20, scheme="sqrtn", row_chunk=6))
+    bad.eval_init(table)
+    with pytest.raises(ValueError, match="row_chunk"):
+        bad.eval_tpu([k0, k1])
+
+
 def test_sqrtn_key_sizes_scale_as_sqrt():
     d = _pair()
     k0, _ = d.gen(0, 1 << 14)

@@ -247,6 +247,78 @@ def test_stage_candidates_hardware_aware():
         for g in groups)
 
 
+def test_sqrtn_knob_space():
+    """scheme='sqrtn' enters the tuner with its own two-knob stage
+    order; candidates honor the live-slab budget and the heuristic is
+    a member."""
+    from dpf_tpu.core import sqrtn
+    assert search.SQRT_STAGES == ("row_chunk", "dot_impl")
+    h = search.heuristic_knobs(4096, 64, prf_method=0, scheme="sqrtn")
+    assert set(h) == {"row_chunk", "dot_impl"}
+    k, r = sqrtn.default_split(4096)
+    assert h["row_chunk"] == sqrtn.choose_row_chunk(k=k, r=r, batch=64)
+    cands = search.stage_candidates("row_chunk", h, n=4096, batch=64,
+                                    prf_method=0, backend="cpu")
+    assert h["row_chunk"] in cands
+    assert cands == sqrtn.sqrt_chunk_candidates(r, k, 64)
+
+
+def test_tune_eval_sqrtn_and_resolution(tmp_path, monkeypatch):
+    """tune_eval over the sqrtn space: gated, tuned <= heuristic, and a
+    fresh DPF resolves row_chunk/dot_impl from the cache at dispatch."""
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    c = tcache.default_cache(refresh=True)
+    n, batch = 1024, 4
+    rec = search.tune_eval(n, batch, reps=1, distinct=4, cache=c,
+                           scheme="sqrtn")
+    assert rec["searched"] and rec["gated"]
+    m = rec["measured"]
+    assert m["best_s"] <= m["heuristic_s"] and m["rejected"] == 0
+    from dpf_tpu.core import sqrtn
+    k, r = sqrtn.default_split(n)
+    assert rec["knobs"]["row_chunk"] in sqrtn.sqrt_chunk_candidates(
+        r, k, batch)
+    dpf = dpf_tpu.DPF(prf=0, scheme="sqrtn")
+    table = np.random.default_rng(2).integers(
+        0, 2 ** 31, (n, 16), dtype=np.int32, endpoint=False)
+    dpf.eval_init(table)
+    knobs = dpf.resolved_eval_knobs(batch)
+    assert knobs == rec["knobs"]
+    ks = [dpf.gen(i, n)[0] for i in range(batch)]
+    assert np.array_equal(np.asarray(dpf.eval_tpu(ks)),
+                          np.asarray(dpf.eval_cpu(ks)))
+
+
+def test_scheme_sweep_records_winner(tmp_path, monkeypatch):
+    """The scheme-level sweep races logn vs radix-4 vs sqrtn, persists
+    a per-(N, B) winner reachable via tune.lookup_scheme, and every
+    construction's tuned time is <= its heuristic."""
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    c = tcache.default_cache(refresh=True)
+    rec = search.scheme_sweep(((512, 4),), reps=1, cache=c, quiet=True)
+    assert rec["checked"]
+    (point,) = rec["points"]
+    labels = {r["construction"] for r in point["constructions"]}
+    assert labels == {"logn", "radix4", "sqrtn"}
+    for row in point["constructions"]:
+        assert row["tuned_s"] <= row["heuristic_s"], row["construction"]
+        assert row["rejected"] == 0, row["construction"]
+    best = min(point["constructions"], key=lambda r: r["tuned_s"])
+    assert point["winner"] == best["construction"]
+    knobs = tcache.lookup_scheme(n=512, entry_size=16, batch=4,
+                                 prf_method=0)
+    assert knobs["construction"] == point["winner"]
+    # nearest-batch fallback answers other batch sizes too
+    assert tcache.lookup_scheme(n=512, entry_size=16, batch=16,
+                                prf_method=0) == knobs
+    # warm cache: a second sweep re-reports without re-searching
+    stores = CACHE_COUNTERS.tuning_stores
+    rec2 = search.scheme_sweep(((512, 4),), reps=1, cache=c, quiet=True)
+    assert all(r["from_cache"]
+               for r in rec2["points"][0]["constructions"])
+    assert CACHE_COUNTERS.tuning_stores == stores + 1  # winner restored
+
+
 def test_serving_warmup_tune_in_place(tmp_path, monkeypatch):
     monkeypatch.setenv("DPF_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
     tcache.default_cache(refresh=True)
