@@ -16,6 +16,13 @@
 # knob-tuned and equality-gated first — and persists the per-shape
 # winning construction in the same tuning cache (committed record:
 # BENCH_SCHEME_r08.json).
+#
+# benchmark.py --batch-pir runs the end-to-end batch-PIR benchmark
+# (dpf_tpu/serve/bench_pir.py): plan -> keygen -> answer -> recover on
+# the production path (batched keygen, packed group decode, tuned
+# knobs, async group dispatch, streaming engine) vs the pre-PR scalar
+# loops, equality-gated (committed record: BENCH_PIR_r09.json).  See
+# docs/BATCH_PIR.md.
 
 import sys
 
@@ -78,6 +85,10 @@ def _autotune_scheme_main(argv):
 
 
 if __name__ == "__main__":
+    if "--batch-pir" in sys.argv:
+        from dpf_tpu.serve.bench_pir import main
+        main([a for a in sys.argv[1:] if a != "--batch-pir"])
+        sys.exit(0)
     if "--autotune-scheme" in sys.argv:
         _autotune_scheme_main(
             [a for a in sys.argv[1:] if a != "--autotune-scheme"])

@@ -19,6 +19,7 @@ import os
 import numpy as np
 
 from .core import evalref, expand, keygen
+from .utils.config import check_construction
 from .core.prf_ref import (PRF_AES128, PRF_CHACHA20, PRF_CHACHA20_BLK,
                            PRF_DUMMY, PRF_NAMES, PRF_SALSA20,
                            PRF_SALSA20_BLK)
@@ -59,6 +60,35 @@ def _native_gen(k, n, seed, prf_method):
         return None
 
 
+def gen_batched_binary(alphas, n, seeds, prf_method: int):
+    """Fastest available batched BINARY keygen: the native C++ per-key
+    generator when the extension is built (byte-identical to the Python
+    DRBG construction, ~an order of magnitude faster per key than the
+    vectorized numpy path at small depths), else
+    ``keygen.gen_batched``.  Returns two [B, 524] int32 arrays either
+    way; shared by ``DPF.gen_batch`` and the batch-PIR client."""
+    # same argument validation as the numpy path (short seed lists and
+    # out-of-range alphas must not reach the native loop)
+    alphas, seeds = keygen._check_batch_args(alphas, n, seeds)
+    try:
+        from . import native
+        have_native = native.available()
+    except Exception:
+        have_native = False
+    if have_native:
+        try:  # bytes(sd): ctypes rejects the bytearray/memoryview seed
+            #  types the validator accepts; any native failure falls
+            #  back to the numpy path (same contract as _native_gen)
+            outs = [native.gen(int(a), n, bytes(sd), prf_method)
+                    for a, sd in zip(alphas, seeds)]
+        except Exception:
+            outs = [None]
+        if all(o is not None for o in outs):
+            return (np.stack([a for a, _ in outs]),
+                    np.stack([b for _, b in outs]))
+    return keygen.gen_batched(alphas, n, seeds, prf_method=prf_method)
+
+
 def _native_expand_batch(keys, prf_method):
     """Native full-expansion fast path; None to fall back to NumPy."""
     try:
@@ -90,18 +120,35 @@ class DPF(object):
 
     DEFAULT_PRF = PRF_AES128
 
-    def __init__(self, prf=None, strict=True, config=None, scheme=None):
+    def __init__(self, prf=None, strict=True, config=None, scheme=None,
+                 entry_size=None):
         """config: optional utils.config.EvalConfig consolidating the
         runtime knobs (prf_method, batch_size, chunk_leaves, dot_impl,
         aes_impl, round_unroll) — the replacement for the reference's
         compile-time -D flag tiers.
 
-        scheme: construction selector ("logn"/"sqrtn") as a direct
-        argument, so scripts don't need a full EvalConfig for it.  It
-        wins over a ``config.scheme`` left at the "logn" default (a
-        frozen dataclass can't tell default from explicit, and knob-only
-        configs must stay combinable); a config pinned to a different
-        non-default construction raises."""
+        scheme: construction selector ("logn"/"sqrtn"/"auto") as a
+        direct argument, so scripts don't need a full EvalConfig for
+        it.  It wins over a ``config.scheme`` left at the "logn"
+        default (a frozen dataclass can't tell default from explicit,
+        and knob-only configs must stay combinable); a config pinned to
+        a different non-default construction raises.
+
+        scheme="auto" defers the construction choice to first use (gen
+        or eval_init): the measured per-shape winner from the tuning
+        cache (``tune.lookup_scheme``, recorded by ``benchmark.py
+        --autotune-scheme``) wins, falling back to the cold-cache
+        heuristic (``tune.search.heuristic_scheme``).  Resolution is
+        sticky — once keys are minted or a table uploaded the
+        construction is pinned (``scheme_resolved_from`` says which
+        path answered).
+
+        entry_size: the table width the scheme-cache lookup is keyed
+        on.  Only meaningful with scheme="auto" on a keygen-only
+        instance (no ``eval_init``): the server resolves with its real
+        table width, so a client minting keys for an E!=16 table MUST
+        pass the same width here or the two sides can resolve
+        different constructions from the same cache."""
         self._config = config
         self.radix = 2
         self.scheme = "logn"
@@ -119,12 +166,18 @@ class DPF(object):
             self.scheme = scheme
         # the ONE validation point for the construction selectors — the
         # config and direct-argument spellings both land here
-        if self.radix not in (2, 4):
-            raise ValueError("radix must be 2 or 4")
-        if self.scheme not in ("logn", "sqrtn"):
-            raise ValueError("scheme must be 'logn' or 'sqrtn'")
-        if self.scheme == "sqrtn" and self.radix == 4:
-            raise ValueError("scheme='sqrtn' has no radix; use radix=2")
+        check_construction(self.scheme, self.radix)
+        if self.scheme == "auto" and self.radix == 4:
+            raise ValueError(
+                "scheme='auto' resolves the whole construction (scheme AND "
+                "radix) from the tuning cache; leave radix at 2")
+        if entry_size is not None and self.scheme != "auto":
+            raise ValueError(
+                "entry_size only parameterizes scheme='auto' resolution "
+                "(the table's own width governs everything else)")
+        self._auto_entry_size = entry_size
+        self.scheme_resolved_from = None  # "cache"/"heuristic" once auto
+        #                                   resolution has run
         self.prf_method = self.DEFAULT_PRF if prf is None else prf
         self.prf_method_string = PRF_NAMES[self.prf_method]
         self.strict = strict          # enforce reference shape limits
@@ -147,13 +200,11 @@ class DPF(object):
         from .core.u128 import next_pow2
         return next_pow2(n)
 
-    def gen(self, k, n, seed: bytes | None = None):
-        """Generate the two servers' keys for secret index k in [0, n).
-
-        With strict=False, non-power-of-two n is allowed (a reference TODO,
-        ``dpf.py:24``): keys are generated over the next power-of-two
-        domain, matching eval_init's zero-padding of the table.
-        """
+    def _check_gen_domain(self, k, n: int) -> int:
+        """The one domain rule for key generation, shared by the scalar
+        and batched paths (`k` is the largest requested index): index in
+        range, then the strict/auto-pad power-of-two policy.  Returns
+        the (possibly padded) domain."""
         if k >= n:
             raise ValueError(
                 "k (%d), the selected element, must be less than n (%d), "
@@ -164,8 +215,51 @@ class DPF(object):
                     "Table num entries (%d) must be a power of two "
                     "(pass strict=False to auto-pad)" % n)
             n = self._pow2_domain(n)
+        return n
+
+    def _ensure_scheme(self, n: int, entry_size: int | None = None):
+        """Resolve ``scheme="auto"`` into a concrete construction for
+        domain ``n``: the scheme-level tuning cache answers first
+        (``tune.lookup_scheme`` — the winner ``benchmark.py
+        --autotune-scheme`` measured for this shape on this machine),
+        else the cold-cache heuristic.  Sticky: the first use (gen or
+        eval_init) pins the construction — keys already minted must
+        stay decodable by this instance."""
+        if self.scheme != "auto":
+            return
+        from .tune.cache import lookup_scheme
+        rec = lookup_scheme(
+            n=n,
+            entry_size=(entry_size or self._auto_entry_size
+                        or self.ENTRY_SIZE),
+            batch=self.BATCH_SIZE, prf_method=self.prf_method)
+        if rec and rec.get("scheme") in ("logn", "sqrtn"):
+            self.scheme_resolved_from = "cache"
+        else:
+            from .tune.search import heuristic_scheme
+            rec = heuristic_scheme(n)
+            self.scheme_resolved_from = "heuristic"
+        self.scheme = rec["scheme"]
+        self.radix = int(rec.get("radix") or 2)
+
+    def gen(self, k, n, seed: bytes | None = None):
+        """Generate the two servers' keys for secret index k in [0, n).
+
+        With strict=False, non-power-of-two n is allowed (a reference TODO,
+        ``dpf.py:24``): keys are generated over the next power-of-two
+        domain, matching eval_init's zero-padding of the table.
+
+        ``k`` may also be a LIST (or 1-D array) of indices: the batch
+        routes through the vectorized generators (``gen_batch``) and two
+        [B, words] key tensors come back, row i bit-identical to the
+        scalar call for ``k[i]``.
+        """
+        if isinstance(k, (list, tuple, np.ndarray)) and np.ndim(k) >= 1:
+            return self.gen_batch(k, n, seeds=seed)
+        n = self._check_gen_domain(k, n)
         if seed is None:
             seed = os.urandom(128)
+        self._ensure_scheme(n)
         if self.scheme == "sqrtn":
             from .core import sqrtn
             k0, k1 = sqrtn.generate_sqrt_keys(k, n, seed, self.prf_method)
@@ -183,6 +277,35 @@ class DPF(object):
             k0, k1 = keygen.generate_keys(k, n, seed, self.prf_method)
             s0, s1 = k0.serialize(), k1.serialize()
         return _maybe_torch(s0, True), _maybe_torch(s1, True)
+
+    def gen_batch(self, indices, n, seeds=None):
+        """Batched keygen: B keys over one domain ``n`` in a few
+        vectorized host calls (``keygen.gen_batched`` /
+        ``radix4.gen_batched_r4`` / ``sqrtn.gen_sqrt_batched``) instead
+        of a per-index ``gen`` loop — the client-side lever of the
+        batch-PIR hot path (one key per bin, hundreds of bins).
+
+        ``seeds``: optional list of per-key DRBG seeds (None = fresh
+        ``os.urandom`` per key).  Returns two [B, words] int32 key
+        tensors; row i is bit-identical to
+        ``gen(indices[i], n, seed=seeds[i])`` (the scalar generator is
+        the fuzz oracle, tests/test_api.py)."""
+        indices = np.asarray(indices, dtype=np.int64).reshape(-1)
+        n = self._check_gen_domain(
+            int(indices.max()) if indices.size else 0, n)
+        self._ensure_scheme(n)
+        if self.scheme == "sqrtn":
+            from .core import sqrtn
+            wa, wb = sqrtn.gen_sqrt_batched(indices, n, seeds,
+                                            prf_method=self.prf_method)
+        elif self.radix == 4:
+            from .core import radix4
+            wa, wb = radix4.gen_batched_r4(indices, n, seeds,
+                                           prf_method=self.prf_method)
+        else:
+            wa, wb = gen_batched_binary(indices, n, seeds,
+                                        self.prf_method)
+        return _maybe_torch(wa, True), _maybe_torch(wb, True)
 
     # ----------------------------------------------------------- eval_init
 
@@ -215,6 +338,7 @@ class DPF(object):
                 "(pass strict=False to lift)" % (e, self.ENTRY_SIZE))
 
         import jax.numpy as jnp
+        self._ensure_scheme(n, e)
         self.table = tbl
         self.table_num_entries = n
         self.table_effective_entry_size = e

@@ -31,6 +31,10 @@ from dataclasses import asdict, dataclass, field
 import numpy as np
 
 
+# the one scheme/radix membership rule, shared with the DPF ctor
+from ..utils.config import check_construction as _check_construction_args
+
+
 @dataclass(frozen=True)
 class HotColdConfig:
     cache_size_fraction: float = 1.0
@@ -47,6 +51,19 @@ class PIRConfig:
     entry_size_bytes: int = 256
     queries_to_hot: int = 1
     queries_to_cold: int = 0
+    # construction the cost model prices upload bytes for: "logn"
+    # (binary GGM or, with radix=4, the mixed-radix tree — both ship the
+    # same fixed wire container) or "sqrtn" (O(sqrt N) keys).  NOT
+    # "auto": the planner prices a concrete construction — resolve the
+    # per-group winner first (PrivateLookupServer.group_constructions)
+    scheme: str = "logn"
+    radix: int = 2
+
+    def __post_init__(self):
+        # the cost model prices a CONCRETE construction: same membership
+        # rule as the serving stack, minus "auto"
+        _check_construction_args(self.scheme, self.radix,
+                                 schemes=("logn", "sqrtn"))
 
 
 @dataclass
@@ -59,11 +76,36 @@ class DPFCost:
         return asdict(self)
 
 
-def dpf_key_cost_bytes(table_size: int) -> int:
-    """Upload bytes per query: 16 B x 4 x log2(n) (ref ``:85-88``)."""
-    if table_size <= 1:
+def dpf_key_cost_bytes(table_size: int, scheme: str = "logn",
+                       radix: int = 2) -> int:
+    """Upload bytes per query: the EXACT wire size of one serialized key
+    for the construction, over the padded power-of-two bin domain the
+    lookup servers actually use (``_pad_pow2``, 128-entry floor).
+
+    The pre-PR model used the reference's analytic ``16 B x 4 x
+    log2(n)`` accounting (ref ``:85-88``) — but real binary-GGM and
+    radix-4 keys ship in the fixed 524-int32 container (2096 B
+    regardless of n), and sqrt-N keys are ``(4 + K + 2R) x 16`` B.
+    Fuzz-checked against ``serialize(...)`` of real keys in
+    tests/test_batch_pir.py, so the planner's upload numbers match what
+    the client transmits byte for byte.
+    """
+    if table_size < 1:
         return 0
-    return int(np.ceil(16 * 4 * np.log2(table_size)))
+    # table_size == 1 still prices a full key: the lookup servers pad
+    # every bin to the 128-entry floor and the client transmits a real
+    # key over that padded domain (the pre-PR analytic model priced
+    # log2(1) = 0 bytes, undercounting single-entry bins by a whole key)
+    # a CONCRETE construction only — resolve "auto" per group first
+    # (PrivateLookupServer.group_constructions)
+    _check_construction_args(scheme, radix, schemes=("logn", "sqrtn"))
+    n = _pad_pow2(table_size)
+    if scheme == "sqrtn":
+        from ..core.sqrtn import default_split
+        k, r = default_split(n)
+        return (4 + k + 2 * r) * 16
+    from ..core.keygen import KEY_WORDS
+    return KEY_WORDS * 4  # both logn radices fill the same container
 
 
 class BatchPIROptimize:
@@ -187,12 +229,13 @@ class BatchPIROptimize:
 
         qh, qc = (self.pir_config.queries_to_hot,
                   self.pir_config.queries_to_cold)
+        sch, rad = self.pir_config.scheme, self.pir_config.radix
         cost = DPFCost(
             computation=qh * len(self.hot_table) + qc * len(self.cold_table),
             upload_communication=(
-                qh * dpf_key_cost_bytes(self.hot_entries_per_bin)
+                qh * dpf_key_cost_bytes(self.hot_entries_per_bin, sch, rad)
                 * len(self.hot_table_bins)
-                + qc * dpf_key_cost_bytes(self.cold_entries_per_bin)
+                + qc * dpf_key_cost_bytes(self.cold_entries_per_bin, sch, rad)
                 * len(self.cold_table_bins)),
             download_communication=(
                 (qh * len(self.hot_table_bins)
@@ -252,29 +295,80 @@ def _pad_pow2(n, lo=128):
     return next_pow2(max(n, lo))
 
 
+def _resolve_construction(scheme: str, radix: int, n: int, group_size: int,
+                          entry_size: int, prf_method: int):
+    """The concrete construction of one (n, G) batch-PIR size group.
+
+    ``scheme="auto"`` asks the scheme-level tuning cache
+    (``tune.lookup_scheme`` — the winner ``benchmark.py
+    --autotune-scheme`` measured for this shape on this machine) and
+    falls back to the caller's explicit ``(logn, radix)`` construction
+    on a cold cache.  Client and server derive this independently, so it
+    must be deterministic given the same bins and tuning-cache state —
+    the same cross-process contract as the stable bin shuffle.
+    """
+    if scheme == "sqrtn":
+        return "sqrtn", 2
+    if scheme == "auto":
+        from ..core.u128 import next_pow2
+        from ..tune.cache import lookup_scheme
+        rec = lookup_scheme(n=n, entry_size=entry_size,
+                            batch=next_pow2(max(1, group_size)),
+                            prf_method=prf_method)
+        if rec and rec.get("scheme") in ("logn", "sqrtn"):
+            return rec["scheme"], int(rec.get("radix") or 2)
+    return "logn", radix
+
+
+@dataclass
+class _SizeGroup:
+    """All bins sharing one padded mini-table size n, stacked."""
+    idxs: list           # bin indices, in stacked (axis 0) order
+    tables: object       # [G + gpad, n, E] device array, permuted per scheme
+    gpad: int            # zero-bin pad rows appended for the mesh
+    scheme: str          # resolved construction for this (n, G) group
+    radix: int
+
+
 class PrivateLookupServer:
     """Holds one bin-structured table; answers DPF queries per bin.
 
     Each bin is padded to a power-of-two mini-table; bins of equal padded
-    size are stacked so one batched per-key-table evaluation
-    (``expand.expand_and_contract_per_key_tables``) answers one query round
-    across all of them in a single device dispatch — the reference's layer
-    loops bins on the host instead.
+    size form one (n, G) *size group* stacked into a [G, n, E] device
+    array, so one batched per-key-table evaluation
+    (``expand.expand_and_contract_per_key_tables`` and its radix-4 /
+    sqrt-N counterparts) answers one query round across all of them in a
+    single device dispatch — the reference's layer loops bins on the
+    host instead.  ``answer`` is the production path: packed wire-codec
+    ingest, tuning-cache knob resolution per group, and ALL groups
+    dispatched asynchronously before one blocking gather;
+    ``answer_scalar`` keeps the per-key scalar path as the parity
+    oracle.  ``stream()`` serves multi-round query streams through one
+    ``ServingEngine`` per size group.
     """
 
     def __init__(self, table: np.ndarray, bins, prf=None, radix: int = 2,
-                 mesh=None):
+                 mesh=None, scheme: str = "logn"):
         """mesh: optional ``jax.sharding.Mesh`` — equal-size bin groups
         are embarrassingly parallel, so the stacked [G, n, E] tables and
         the per-bin key batch shard over ALL mesh axes flattened onto
         the group axis (G padded with zero bins to the device count);
         one query round then runs as one SPMD dispatch across the mesh.
-        The reference has no multi-device batch-PIR at all."""
+        The reference has no multi-device batch-PIR at all.
+
+        scheme: "logn" (binary GGM, or the radix-4 tree with radix=4),
+        "sqrtn" (O(sqrt N) keys, flat PRF grid), or "auto" — each
+        (n, G) size group resolves its construction from the scheme
+        tuning cache via ``_resolve_construction`` (cold cache: the
+        explicit logn/radix construction).  The client must be built
+        with the same scheme/radix arguments so both sides derive the
+        same per-group construction."""
         from ..api import DPF
         from ..core import expand, radix4
+        _check_construction_args(scheme, radix)
         self.prf_method = DPF.DEFAULT_PRF if prf is None else prf
-        assert radix in (2, 4)
         self.radix = radix
+        self.scheme = scheme
         self.mesh = mesh
         self.entry_size = table.shape[1]
         self.bins = [sorted(b) for b in bins]
@@ -288,8 +382,10 @@ class PrivateLookupServer:
             padded_tables.append(padded)
             self.bin_sizes.append(n)
 
-        def permute(padded):
-            if radix == 4:
+        def permute(padded, sch, rad):
+            if sch == "sqrtn":  # the sqrt-N grid emits natural order
+                return padded
+            if rad == 4:
                 perm = radix4.mixed_reverse_indices(
                     radix4.arities(padded.shape[0]))
                 return np.ascontiguousarray(padded[perm])
@@ -298,14 +394,18 @@ class PrivateLookupServer:
         # group bins by padded size -> one stacked [G, n, E] device array
         # each; with a mesh, G pads to the device count and shards
         import jax.numpy as jnp
-        self._groups = {}  # n -> (bin indices, stacked tables, group pad)
+        by_size = {}  # n -> (bin indices, natural padded tables)
         for bi, (n, padded) in enumerate(zip(self.bin_sizes, padded_tables)):
-            self._groups.setdefault(n, [[], []])
-            self._groups[n][0].append(bi)
-            self._groups[n][1].append(permute(padded))
-        out = {}
-        for n, (idxs, tbls) in self._groups.items():
-            stacked = np.stack(tbls)
+            by_size.setdefault(n, ([], []))
+            by_size[n][0].append(bi)
+            by_size[n][1].append(padded)
+        self._groups = {}
+        self._tuned = {}  # (n, batch, scheme, radix) -> tuning-cache knobs
+        for n, (idxs, tbls) in by_size.items():
+            sch, rad = _resolve_construction(
+                scheme, radix, n, len(idxs), self.entry_size,
+                self.prf_method)
+            stacked = np.stack([permute(t, sch, rad) for t in tbls])
             pad = 0
             if mesh is not None:
                 pad = (-stacked.shape[0]) % mesh.size
@@ -316,8 +416,12 @@ class PrivateLookupServer:
                 stacked = self._shard(jnp.asarray(stacked))
             else:
                 stacked = jnp.asarray(stacked)
-            out[n] = (idxs, stacked, pad)
-        self._groups = out
+            self._groups[n] = _SizeGroup(idxs, stacked, pad, sch, rad)
+
+    def group_constructions(self) -> dict:
+        """{bin size n: (scheme, radix)} — what each size group resolved
+        to (diagnostics; with scheme="auto" this is the cache answer)."""
+        return {n: (g.scheme, g.radix) for n, g in self._groups.items()}
 
     def _shard(self, arr):
         """Shard axis 0 (the bin-group axis) over every mesh axis."""
@@ -341,85 +445,460 @@ class PrivateLookupServer:
                        if self.mesh is not None else jnp.asarray(a))
         return out
 
-    def answer(self, keys_per_bin):
-        """keys_per_bin: one serialized key per bin -> [n_bins, E] shares."""
-        from ..core import expand, keygen, radix4
+    # ------------------------------------------------------ the hot path
+
+    def _group_knobs(self, n: int, batch: int, sch: str, rad: int) -> dict:
+        """Program knobs for one (n, G) dispatch, tuning-cache first.
+
+        The per-shape tuned entries (``tune.cache.lookup_eval_knobs``,
+        populated by ``benchmark.py --autotune``/``--autotune-scheme``,
+        nearest-batch fallback included) replace the pre-PR frozen
+        heuristics; fields the cache cannot answer fall back to the
+        same static choices (``expand.choose_chunk`` et al.).  The cache
+        lookup is memoized per (n, batch, construction); the
+        process-global fallbacks are re-read every call so
+        ``set_dot_impl``/``apply_globals`` stay live, matching
+        ``DPF.resolved_eval_knobs``."""
+        from ..core import expand
         from ..core import prf as _prf
         from ..ops import matmul128
+        key = (n, batch, sch, rad)
+        tuned = self._tuned.get(key)
+        if tuned is None:
+            from ..tune.cache import lookup_eval_knobs
+            tuned = lookup_eval_knobs(
+                n=n, entry_size=self.entry_size, batch=batch,
+                prf_method=self.prf_method, scheme=sch, radix=rad) or {}
+            self._tuned[key] = tuned
+        if sch == "sqrtn":
+            return {"dot_impl": tuned.get("dot_impl")
+                    or matmul128.default_impl(),
+                    # clamped against the decoded batch's split at
+                    # dispatch (sqrtn.clamp_row_chunk)
+                    "row_chunk": tuned.get("row_chunk")}
+        chunk = tuned.get("chunk_leaves")
+        if tuned.get("kernel_impl", "xla") != "xla":
+            # a tuned chunk rides only with ITS kernel; the
+            # per-key-tables program is always the fused xla one
+            chunk = None
+        return {"chunk_leaves": expand.clamp_chunk(chunk, n, batch),
+                "dot_impl": tuned.get("dot_impl")
+                or matmul128.default_impl(),
+                "aes_impl": tuned.get("aes_impl") or _prf._aes_pair_impl(),
+                "round_unroll": tuned.get("round_unroll",
+                                          _prf.ROUND_UNROLL)}
+
+    def _decode_group(self, n: int, grp: _SizeGroup, keys):
+        """Packed-codec ingest for one size group's key list, with
+        fail-fast validation: a wrong-domain or wrong-construction key
+        is reported with its BIN index before any batch decode work (the
+        pre-PR loop deserialized the whole group first)."""
+        from ..core import keygen, radix4, sqrtn
+        if len(keys) != len(grp.idxs):
+            raise ValueError("size-%d group: expected %d keys, got %d"
+                             % (n, len(grp.idxs), len(keys)))
+        if grp.scheme == "sqrtn":
+            try:
+                arr = sqrtn.stack_sqrt_wire_keys(keys)
+                kn = sqrtn.sqrt_wire_ns(arr)
+            except ValueError as exc:
+                raise ValueError("size-%d group (bins %s): %s"
+                                 % (n, grp.idxs, exc)) from None
+            bad = np.flatnonzero(kn != n)
+            if bad.size:
+                raise ValueError(
+                    "key for bin %d (bin size %d) got n=%d"
+                    % (grp.idxs[bad[0]], n, kn[bad[0]]))
+            return sqrtn.decode_sqrt_keys_batched(arr)
+        try:
+            arr = keygen.stack_wire_keys(keys)
+        except ValueError as exc:
+            raise ValueError("size-%d group (bins %s): %s"
+                             % (n, grp.idxs, exc)) from None
+        marker, kn = keygen.wire_headers(arr)
+        bad = np.flatnonzero(marker != (4 if grp.radix == 4 else 0))
+        if bad.size:
+            raise ValueError(
+                "key for bin %d (bin size %d) is not a %s key "
+                "(radix marker %d)"
+                % (grp.idxs[bad[0]], n,
+                   "radix-4" if grp.radix == 4 else "binary",
+                   marker[bad[0]]))
+        bad = np.flatnonzero(kn != n)
+        if bad.size:
+            raise ValueError("key for bin %d (bin size %d) got n=%d"
+                             % (grp.idxs[bad[0]], n, kn[bad[0]]))
+        decode = (radix4.decode_mixed_keys_batched if grp.radix == 4
+                  else keygen.decode_keys_batched)
+        return decode(arr)
+
+    def _run_group_program(self, n: int, grp: _SizeGroup, pk, tables=None):
+        """Dispatch one packed key batch against the group's stacked
+        tables (``tables`` overrides for the streaming pad) and return
+        the device array WITHOUT forcing a host sync — JAX async
+        dispatch lets the caller enqueue every group before blocking."""
+        from ..core import expand, radix4, sqrtn
+        tables = grp.tables if tables is None else tables
+        knobs = self._group_knobs(n, pk.batch, grp.scheme, grp.radix)
+        if grp.scheme == "sqrtn":
+            seeds, cw1, cw2 = self._pad_keys(
+                (pk.seeds, pk.cw1, pk.cw2), 0)
+            rc = sqrtn.clamp_row_chunk(knobs["row_chunk"], pk.n_codewords,
+                                       pk.n_keys, pk.batch)
+            return sqrtn.eval_contract_per_key_tables(
+                seeds, cw1, cw2, tables, prf_method=self.prf_method,
+                dot_impl=knobs["dot_impl"], row_chunk=rc)
+        cw1, cw2, last = self._pad_keys((pk.cw1, pk.cw2, pk.last), 0)
+        if grp.radix == 4:
+            return radix4.expand_and_contract_per_key_tables_mixed(
+                cw1, cw2, last, tables, n=n, prf_method=self.prf_method,
+                **knobs)
+        return expand.expand_and_contract_per_key_tables(
+            cw1, cw2, last, tables, depth=n.bit_length() - 1,
+            prf_method=self.prf_method, **knobs)
+
+    def answer(self, keys_per_bin):
+        """keys_per_bin: one serialized key per bin -> [n_bins, E] shares.
+
+        The production path: per size group the whole key batch decodes
+        through the packed wire codec (``_decode_group``), knobs resolve
+        from the tuning cache (``_group_knobs``), and every group's
+        jitted program is dispatched asynchronously — one blocking
+        gather at the end instead of the pre-PR host round-trip per
+        group.  Bit-identical to ``answer_scalar``."""
+        if len(keys_per_bin) != len(self.bins):
+            raise ValueError("expected one key per bin (%d bins), got %d"
+                             % (len(self.bins), len(keys_per_bin)))
+        pending = []
+        for n, grp in self._groups.items():
+            pk = self._decode_group(n, grp,
+                                    [keys_per_bin[bi] for bi in grp.idxs])
+            pk = pk.pad_to(len(grp.idxs) + grp.gpad)
+            pending.append((grp, self._run_group_program(n, grp, pk)))
         out = np.zeros((len(self.bins), self.entry_size), np.int32)
-        for n, (idxs, tables, gpad) in self._groups.items():
-            if self.radix == 4:
-                mk = [radix4.deserialize_mixed_key(keys_per_bin[bi])
-                      for bi in idxs]
-                for k in mk:
+        for grp, dev in pending:
+            out[grp.idxs] = np.asarray(dev)[:len(grp.idxs)]
+        return out
+
+    def answer_scalar(self, keys_per_bin):
+        """The pre-batched answer path, kept as the parity oracle (and
+        the benchmark baseline): per-key scalar deserialize + pack,
+        static heuristic knobs, one blocking host sync per size group.
+        Same device kernels, so ``answer`` must match it bit for bit
+        (asserted in tests and in ``serve/bench_pir.py`` before any
+        timing)."""
+        from ..core import expand, keygen, radix4, sqrtn
+        from ..core import prf as _prf
+        from ..ops import matmul128
+        if len(keys_per_bin) != len(self.bins):
+            raise ValueError("expected one key per bin (%d bins), got %d"
+                             % (len(self.bins), len(keys_per_bin)))
+        out = np.zeros((len(self.bins), self.entry_size), np.int32)
+        for n, grp in self._groups.items():
+            keys = [keys_per_bin[bi] for bi in grp.idxs]
+            if grp.scheme == "sqrtn":
+                sk = [sqrtn.deserialize_sqrt_key(k) for k in keys]
+                for bi, k in zip(grp.idxs, sk):
                     if k.n != n:
                         raise ValueError(
-                            "key for bin of size %d got n=%d" % (n, k.n))
+                            "key for bin %d (bin size %d) got n=%d"
+                            % (bi, n, k.n))
+                seeds, cw1, cw2 = self._pad_keys(
+                    sqrtn.pack_sqrt_keys(sk), grp.gpad)
+                shares = sqrtn.eval_contract_per_key_tables(
+                    seeds, cw1, cw2, grp.tables,
+                    prf_method=self.prf_method,
+                    dot_impl=matmul128.default_impl())
+            elif grp.radix == 4:
+                mk = [radix4.deserialize_mixed_key(k) for k in keys]
+                for bi, k in zip(grp.idxs, mk):
+                    if k.n != n:
+                        raise ValueError(
+                            "key for bin %d (bin size %d) got n=%d"
+                            % (bi, n, k.n))
                 cw1, cw2, last = self._pad_keys(
-                    radix4.pack_mixed_keys(mk), gpad)
+                    radix4.pack_mixed_keys(mk), grp.gpad)
                 shares = radix4.expand_and_contract_per_key_tables_mixed(
-                    cw1, cw2, last, tables, n=n,
+                    cw1, cw2, last, grp.tables, n=n,
                     prf_method=self.prf_method,
                     chunk_leaves=expand.choose_chunk(n, len(mk)),
                     dot_impl=matmul128.default_impl(),
                     aes_impl=_prf._aes_pair_impl(),
                     round_unroll=_prf.ROUND_UNROLL)
-                out[idxs] = np.asarray(shares)[:len(idxs)]
-                continue
-            flat = [keygen.deserialize_key(keys_per_bin[bi]) for bi in idxs]
-            for fk in flat:
-                if fk.n != n:
-                    raise ValueError(
-                        "key for bin of size %d got n=%d" % (n, fk.n))
-            cw1, cw2, last = self._pad_keys(expand.pack_keys(flat), gpad)
-            depth = n.bit_length() - 1
-            shares = expand.expand_and_contract_per_key_tables(
-                cw1, cw2, last, tables, depth=depth,
-                prf_method=self.prf_method,
-                chunk_leaves=expand.choose_chunk(n, len(flat)),
-                dot_impl=matmul128.default_impl(),
-                aes_impl=_prf._aes_pair_impl(),
-                round_unroll=_prf.ROUND_UNROLL)
-            out[idxs] = np.asarray(shares)[:len(idxs)]
+            else:
+                flat = [keygen.deserialize_key(k) for k in keys]
+                for bi, fk in zip(grp.idxs, flat):
+                    if fk.n != n:
+                        raise ValueError(
+                            "key for bin %d (bin size %d) got n=%d"
+                            % (bi, n, fk.n))
+                cw1, cw2, last = self._pad_keys(
+                    expand.pack_keys(flat), grp.gpad)
+                shares = expand.expand_and_contract_per_key_tables(
+                    cw1, cw2, last, grp.tables, depth=n.bit_length() - 1,
+                    prf_method=self.prf_method,
+                    chunk_leaves=expand.choose_chunk(n, len(flat)),
+                    dot_impl=matmul128.default_impl(),
+                    aes_impl=_prf._aes_pair_impl(),
+                    round_unroll=_prf.ROUND_UNROLL)
+            out[grp.idxs] = np.asarray(shares)[:len(grp.idxs)]
         return out
+
+    # ------------------------------------------------------- streaming
+
+    def stream(self, *, max_in_flight: int = 2, warmup: bool = True):
+        """A ``LookupStream`` serving multi-round query batches through
+        one ``ServingEngine`` per (n, G) size group — vectorized ingest,
+        precompiled fixed shapes (shape buckets keyed on the group), and
+        an in-flight dispatch window per group.  See docs/BATCH_PIR.md.
+        """
+        return LookupStream(self, max_in_flight=max_in_flight,
+                            warmup=warmup)
+
+
+class _GroupStreamServer:
+    """``ServingEngine`` adapter presenting one (n, G) size group as a
+    standalone server: the engine only needs the
+    ``_decode_batch``/``_dispatch_packed`` pair plus shape attributes.
+    A group's batch is ALWAYS exactly its (mesh-padded) size — one key
+    per bin — so the dispatch trims the engine's power-of-two bucket
+    pad back off and runs the same exact-shape program as ``answer``
+    (no pad rows evaluated; the single bucket exists to satisfy the
+    engine's shape discipline and its warmup precompile)."""
+
+    def __init__(self, owner: PrivateLookupServer, n: int,
+                 grp: _SizeGroup):
+        self._owner = owner
+        self._grp = grp
+        self._gtot = len(grp.idxs) + grp.gpad
+        self.n = n                      # engine: depth for warmup keys
+        self.entry_size = owner.entry_size
+        self.batch_size = self._gtot    # engine: dispatch cap
+        self.scheme = grp.scheme        # engine: sqrt-N warmup key shape
+
+    def _decode_batch(self, keys):
+        if hasattr(keys, "batch"):  # pre-decoded by LookupStream.submit
+            return keys             # (all-groups-validate-first contract)
+        return self._owner._decode_group(self.n, self._grp, keys)
+
+    def _dispatch_packed(self, pk):
+        pk = (pk.slice(0, self._gtot) if pk.batch > self._gtot
+              else pk.pad_to(self._gtot))
+        return self._owner._run_group_program(self.n, self._grp, pk)
+
+
+class LookupRoundFuture:
+    """One submitted query round; ``result()`` assembles the
+    [n_bins, E] share matrix from the per-group engine futures (blocking
+    only on this round's dispatches, FIFO per group)."""
+
+    __slots__ = ("_n_bins", "_entry_size", "_parts", "_value")
+
+    def __init__(self, n_bins, entry_size, parts):
+        self._n_bins = n_bins
+        self._entry_size = entry_size
+        self._parts = parts             # [(group, EngineFuture)]
+        self._value = None
+
+    def done(self) -> bool:
+        """True once this round has been RESOLVED — its result
+        materialized by ``result()`` or a covering ``drain()``.  The
+        engines are threadless (EngineFuture contract): nothing flips
+        this in the background, so call ``result()`` to block rather
+        than polling."""
+        return (self._value is not None
+                or all(f.done() for _, f in self._parts))
+
+    def result(self) -> np.ndarray:
+        if self._value is None:
+            out = np.zeros((self._n_bins, self._entry_size), np.int32)
+            for grp, fut in self._parts:
+                out[grp.idxs] = fut.result()
+            self._value = out
+            self._parts = []
+        return self._value
+
+
+class LookupStream:
+    """Streaming batch-PIR serving: multi-round query batches pipelined
+    through one ``ServingEngine`` per (n, G) size group.
+
+    Each engine owns a single shape bucket (the group's padded
+    power-of-two size), so ingest is the packed group codec, the
+    program shape is fixed and precompiled at warmup, and up to
+    ``max_in_flight`` rounds per group overlap host decode with device
+    execution (on a synchronous backend the win is the ingest + shape
+    reuse).  ``submit`` returns a ``LookupRoundFuture`` immediately;
+    results are bit-identical to ``PrivateLookupServer.answer``.
+    """
+
+    def __init__(self, server: PrivateLookupServer, *,
+                 max_in_flight: int = 2, warmup: bool = True):
+        from ..core.u128 import next_pow2
+        from ..serve import ServingEngine
+        self._server = server
+        self._n_bins = len(server.bins)
+        self._engines = []              # [(n, group, engine)]
+        for n, grp in server._groups.items():
+            bucket = next_pow2(len(grp.idxs) + grp.gpad)
+            adapter = _GroupStreamServer(server, n, grp)
+            self._engines.append((n, grp, ServingEngine(
+                adapter, max_in_flight=max_in_flight, buckets=[bucket],
+                warmup=warmup)))
+
+    def submit(self, keys_per_bin) -> LookupRoundFuture:
+        """Decode + dispatch one query round (one key per bin); returns
+        a future immediately.  Backpressure applies per group engine.
+
+        EVERY group decodes (and fail-fast validates) before ANY engine
+        dispatch: a bad key in a later group must not leave earlier
+        groups' dispatches orphaned in their in-flight windows (or skew
+        their counters) — the engines then receive the pre-decoded
+        packed batches."""
+        if len(keys_per_bin) != self._n_bins:
+            raise ValueError("expected one key per bin (%d bins), got %d"
+                             % (self._n_bins, len(keys_per_bin)))
+        decoded = [
+            (grp, eng, self._server._decode_group(
+                n, grp, [keys_per_bin[bi] for bi in grp.idxs]))
+            for n, grp, eng in self._engines]
+        parts = [(grp, eng.submit(pk)) for grp, eng, pk in decoded]
+        return LookupRoundFuture(self._n_bins, self._server.entry_size,
+                                 parts)
+
+    def drain(self) -> None:
+        """Resolve every outstanding dispatch across all group engines."""
+        for _, _, eng in self._engines:
+            eng.drain()
+
+    def stats(self) -> dict:
+        """Per-group engine counters, keyed "n<bin size>xG<group size>"."""
+        return {"n%dxG%d" % (n, len(grp.idxs)): eng.stats.as_dict()
+                for n, grp, eng in self._engines}
 
 
 class PrivateLookupClient:
-    """Generates per-bin keys for a planned fetch and recovers entries."""
+    """Generates per-bin keys for a planned fetch and recovers entries.
 
-    def __init__(self, bins, bin_sizes, prf=None, radix: int = 2):
+    ``make_queries`` is the production path: one *vectorized* batched
+    keygen call per (n, G) size group (``keygen.gen_batched`` /
+    ``radix4.gen_batched_r4`` / ``sqrtn.gen_sqrt_batched``) instead of
+    the pre-PR per-bin ``DPF.gen`` Python loop;
+    ``make_queries_scalar`` keeps that loop as the fuzz oracle
+    (bit-identical keys under pinned seeds).  ``scheme``/``radix``
+    mirror the server's arguments — with "auto", each size group's
+    construction resolves from the scheme tuning cache on both sides,
+    so ``entry_size`` is REQUIRED then and must be the server table's
+    width (it is part of the cache key; a mismatch would resolve a
+    different construction than the server's)."""
+
+    def __init__(self, bins, bin_sizes, prf=None, radix: int = 2,
+                 scheme: str = "logn", entry_size: int | None = None):
         from ..api import DPF
-        if radix == 4:
-            from ..utils.config import EvalConfig
-            self.dpf = DPF(config=EvalConfig(
-                prf_method=DPF.DEFAULT_PRF if prf is None else prf,
-                radix=4))
-        else:
-            self.dpf = DPF(prf=prf)
+        _check_construction_args(scheme, radix)
+        if scheme == "auto" and entry_size is None:
+            raise ValueError(
+                "scheme='auto' resolves constructions from the tuning "
+                "cache keyed on the table's entry width — pass "
+                "entry_size=<server table width>")
+        if entry_size is None:
+            entry_size = DPF.ENTRY_SIZE  # unused outside auto resolution
+        self.prf_method = DPF.DEFAULT_PRF if prf is None else prf
+        self.radix = radix
+        self.scheme = scheme
+        self.entry_size = entry_size
         self.bins = [sorted(b) for b in bins]
-        self.bin_sizes = bin_sizes
+        self.bin_sizes = list(bin_sizes)
         self.index_to_bin = {}
         for bi, b in enumerate(self.bins):
             for pos, idx in enumerate(b):
                 self.index_to_bin[idx] = (bi, pos)
+        # size groups in bin order — mirrors the server's grouping, so
+        # the per-group construction resolution agrees on (n, G)
+        self._size_groups = {}
+        for bi, n in enumerate(self.bin_sizes):
+            self._size_groups.setdefault(n, []).append(bi)
+        self._constructions = {
+            n: _resolve_construction(scheme, radix, n, len(idxs),
+                                     entry_size, self.prf_method)
+            for n, idxs in self._size_groups.items()}
+        self._scalar_dpfs = {}
 
-    def make_queries(self, wanted):
-        """Pick <=1 wanted index per bin; others get a dummy (position 0).
+    def group_constructions(self) -> dict:
+        """{bin size n: (scheme, radix)} — must equal the server's."""
+        return dict(self._constructions)
 
-        Returns (keys for server A, keys for server B, plan) where plan[bin]
-        is the table index retrieved there (or None for dummy queries —
-        indistinguishable from real ones to each server).
-        """
+    def _plan(self, wanted):
         plan = [None] * len(self.bins)
         for idx in wanted:
             if idx in self.index_to_bin:
                 bi, _ = self.index_to_bin[idx]
                 if plan[bi] is None:
                     plan[bi] = idx
+        return plan
+
+    def make_queries(self, wanted, seeds=None):
+        """Pick <=1 wanted index per bin; others get a dummy (position 0).
+
+        Returns (keys for server A, keys for server B, plan) where plan[bin]
+        is the table index retrieved there (or None for dummy queries —
+        indistinguishable from real ones to each server).  Keys are
+        generated per size group by the batched generators — one
+        vectorized call per (n, G) group.  ``seeds``: optional per-bin
+        DRBG seed list (None = fresh entropy; tests pin it for
+        bit-parity with ``make_queries_scalar``).
+        """
+        from ..api import gen_batched_binary
+        from ..core import radix4, sqrtn
+        plan = self._plan(wanted)
+        pos = [self.index_to_bin[t][1] if t is not None else 0
+               for t in plan]
+        ka = [None] * len(self.bins)
+        kb = [None] * len(self.bins)
+        for n, idxs in self._size_groups.items():
+            sch, rad = self._constructions[n]
+            alphas = [pos[bi] for bi in idxs]
+            sd = None if seeds is None else [seeds[bi] for bi in idxs]
+            if sch == "sqrtn":
+                wa, wb = sqrtn.gen_sqrt_batched(
+                    alphas, n, sd, prf_method=self.prf_method)
+            elif rad == 4:
+                wa, wb = radix4.gen_batched_r4(
+                    alphas, n, sd, prf_method=self.prf_method)
+            else:
+                wa, wb = gen_batched_binary(alphas, n, sd,
+                                            self.prf_method)
+            for p, bi in enumerate(idxs):
+                ka[bi] = wa[p]
+                kb[bi] = wb[p]
+        return ka, kb, plan
+
+    def _scalar_dpf(self, sch: str, rad: int):
+        from ..api import DPF
+        key = (sch, rad)
+        if key not in self._scalar_dpfs:
+            if rad == 4:
+                from ..utils.config import EvalConfig
+                self._scalar_dpfs[key] = DPF(config=EvalConfig(
+                    prf_method=self.prf_method, radix=4))
+            else:
+                self._scalar_dpfs[key] = DPF(prf=self.prf_method,
+                                             scheme=sch)
+        return self._scalar_dpfs[key]
+
+    def make_queries_scalar(self, wanted, seeds=None):
+        """The pre-batched per-bin ``DPF.gen`` loop, kept as the fuzz
+        oracle (and the benchmark's keygen baseline): byte-identical
+        keys to ``make_queries`` under the same ``seeds``."""
+        plan = self._plan(wanted)
         ka, kb = [], []
         for bi, target in enumerate(plan):
             pos = self.index_to_bin[target][1] if target is not None else 0
-            k1, k2 = self.dpf.gen(pos, self.bin_sizes[bi])
+            n = self.bin_sizes[bi]
+            sch, rad = self._constructions[n]
+            dpf = self._scalar_dpf(sch, rad)
+            k1, k2 = dpf.gen(pos, n,
+                             seed=None if seeds is None else seeds[bi])
             ka.append(k1)
             kb.append(k2)
         return ka, kb, plan

@@ -153,6 +153,20 @@ class PackedKeys:
             self.depth, self.n)
 
 
+def wire_headers(arr: np.ndarray):
+    """Per-key ``(radix marker, table size n)`` from a stacked
+    [B, 524] wire buffer — the fixed container's header limbs (marker
+    at slot 0 limb 1: 0 = binary, 4 = mixed-radix; n at slot 130,
+    limbs 0/1).  The one wire-header reader outside the decoders
+    (mirrors ``sqrtn.sqrt_wire_ns``), exported so batch callers can
+    attribute a wrong-construction or wrong-domain key to its batch
+    position before the full decode."""
+    slots = arr.view(np.uint32).reshape(-1, 131, 4)
+    n = (slots[:, 130, 0].astype(np.int64)
+         | (slots[:, 130, 1].astype(np.int64) << 32))
+    return slots[:, 0, 1], n
+
+
 def decode_keys_batched(keys) -> PackedKeys:
     """Vectorized wire -> packed-arrays codec for a uniform key batch.
 
@@ -266,6 +280,171 @@ def generate_keys(alpha: int, n: int, seed: bytes, prf_method: int,
     ka = FlatKey(depth=depth, cw1=cw1, cw2=cw2, last_key=k1, n=n)
     kb = FlatKey(depth=depth, cw1=cw1.copy(), cw2=cw2.copy(), last_key=k2, n=n)
     return ka, kb
+
+
+# ---------------------------------------------------------------------------
+# Batched key generation (vectorized over B independent indices)
+# ---------------------------------------------------------------------------
+
+def drbg_u128_batch(seeds, n_draws: int) -> np.ndarray:
+    """Every key's first ``n_draws`` DRBG u128 draws: [B, n_draws, 4] uint32.
+
+    ``Shake256Drbg`` is a pure byte stream, so drawing ``16 * n_draws``
+    bytes at once and viewing them as little-endian limb rows is
+    byte-identical to ``n_draws`` sequential ``u128()`` calls — the ONE
+    per-key Python loop of the batched generators lives here and is a
+    single SHAKE squeeze + frombuffer per key.  Draw-site modifications
+    (``& ~1`` / ``| 1`` of the odd/even draws) are applied by the
+    callers on the limb arrays, vectorized over the batch.
+    """
+    out = np.empty((len(seeds), n_draws, 4), dtype=np.uint32)
+    for i, s in enumerate(seeds):
+        out[i] = np.frombuffer(Shake256Drbg(s).bytes(16 * n_draws),
+                               dtype=np.uint32).reshape(n_draws, 4)
+    return out
+
+
+def _check_batch_args(alphas, n: int, seeds):
+    alphas = np.asarray(alphas, dtype=np.int64).reshape(-1)
+    if alphas.size == 0:
+        raise ValueError("empty index batch")
+    if n & (n - 1) != 0 or n < 2:
+        raise ValueError("table size (%d) must be a power of two >= 2" % n)
+    if (alphas < 0).any() or (alphas >= n).any():
+        bad = int(alphas[(alphas < 0) | (alphas >= n)][0])
+        raise ValueError("alpha (%d) must be in [0, %d)" % (bad, n))
+    if seeds is None:
+        import os
+        seeds = [os.urandom(128) for _ in range(alphas.size)]
+    if isinstance(seeds, (bytes, bytearray)):
+        # a scalar seed would zip into per-BYTE "seeds" (each an int,
+        # which bytes() turns into a low-entropy all-zero DRBG seed)
+        raise TypeError(
+            "seeds must be a LIST of per-key byte strings, got a single "
+            "%s — every key needs its own DRBG seed" % type(seeds).__name__)
+    if len(seeds) != alphas.size:
+        raise ValueError("need one seed per index (%d != %d)"
+                         % (len(seeds), alphas.size))
+    for s in seeds:
+        if not isinstance(s, (bytes, bytearray, memoryview)):
+            raise TypeError("per-key seeds must be bytes, got %s"
+                            % type(s).__name__)
+    return alphas, seeds
+
+
+def _wire_batch(cw1, cw2, last, depth: int, n: int,
+                radix_slot0=None) -> np.ndarray:
+    """Serialize a whole key batch: [B, 64, 4]+[B, 4] -> [B, 524] int32
+    (vectorized ``FlatKey.serialize`` / ``MixedKey.serialize``)."""
+    bsz = last.shape[0]
+    slots = np.zeros((bsz, 131, 4), dtype=np.uint32)
+    slots[:, 0, 0] = depth
+    if radix_slot0 is not None:  # (marker, n_binary_levels) for radix-4
+        slots[:, 0, 1], slots[:, 0, 2] = radix_slot0
+    slots[:, 1:65] = cw1
+    slots[:, 65:129] = cw2
+    slots[:, 129] = last
+    slots[:, 130, 0] = np.uint32(n & 0xFFFFFFFF)
+    slots[:, 130, 1] = np.uint32(n >> 32)
+    return slots.reshape(bsz, -1).view(np.int32)
+
+
+def gen_batched(alphas, n: int, seeds=None, *, prf_method: int,
+                beta: int = 1):
+    """Vectorized two-server keygen over B independent point functions.
+
+    The batched counterpart of ``generate_keys`` for a uniform domain
+    ``n``: correction words for all B keys are derived together — one
+    DRBG squeeze per key (``drbg_u128_batch``), then ``O(log N)``
+    *vectorized* PRF calls (``prf.prf_v`` over [B, 4] limb tensors)
+    instead of ``O(B log N)`` Python-int PRF calls.  Bit-identical to
+    ``generate_keys(alphas[i], n, seeds[i])`` per key (the scalar
+    generator stays the fuzz oracle; asserted in tests/test_keygen.py).
+
+    Returns ``(wire_a, wire_b)``: two [B, 524] int32 arrays of
+    serialized keys (rows are valid wire keys for every existing
+    consumer, and the stacked form feeds ``stack_wire_keys`` with no
+    re-stacking).
+    """
+    from .prf import prf_v
+    alphas, seeds = _check_batch_args(alphas, n, seeds)
+    depth = n.bit_length() - 1
+    if depth > MAX_DEPTH:
+        raise ValueError("table size 2^%d exceeds max 2^32" % depth)
+    bsz = alphas.size
+    n_draws = 4 if depth == 1 else 3 * depth + 1
+    draws = drbg_u128_batch(seeds, n_draws)
+    cur = 0
+
+    def draw():
+        nonlocal cur
+        v = draws[:, cur, :]
+        cur += 1
+        return v
+
+    def odd(v):
+        v = v.copy()
+        v[:, 0] |= np.uint32(1)
+        return v
+
+    beta_c = np.broadcast_to(u128.int_to_limbs(beta), (bsz, 4))
+    bits = ((alphas[:, None] >> np.arange(depth, dtype=np.int64)[None, :])
+            & 1).astype(np.uint32)                    # [B, depth]
+    cw1 = np.zeros((bsz, 64, 4), dtype=np.uint32)
+    cw2 = np.zeros((bsz, 64, 4), dtype=np.uint32)
+    rows = np.arange(bsz)
+
+    # --- base level (flat index depth-1) handles bit 0 of alpha ----------
+    k1 = draw().copy()
+    k1[:, 0] &= np.uint32(0xFFFFFFFE)                 # server 0: LSB 0
+    k2 = odd(draw())                                  # server 1: LSB 1
+    beta_l = beta_c if depth == 1 else odd(draw())
+    i = depth - 1
+    b0 = bits[:, 0]
+    c1 = [draw(), draw()]
+    for b in (0, 1):
+        d = u128.sub128(prf_v(prf_method, k1, b), prf_v(prf_method, k2, b))
+        d = np.where((b0 == b)[:, None], u128.sub128(d, beta_l), d)
+        cw1[:, 2 * i + b] = c1[b]
+        cw2[:, 2 * i + b] = u128.add128(c1[b], d)
+    c1_t = np.where((b0 == 1)[:, None], c1[1], c1[0])
+    s1 = u128.add128(prf_v(prf_method, k1, b0), c1_t)
+    s2 = u128.add128(prf_v(prf_method, k2, b0), cw2[rows, 2 * i + b0])
+
+    # --- upper levels, bottom to top --------------------------------------
+    for l in range(1, depth):
+        if not ((u128.sub128(s1, s2) == beta_l).all()
+                and (((s1[:, 0] ^ s2[:, 0]) & 1) == 1).all()):
+            raise AssertionError(
+                "batched keygen invariant broken at level %d: seed shares "
+                "must differ by the odd beta' (and so in LSB)" % l)
+        i = depth - 1 - l
+        beta_l = beta_c if l == depth - 1 else odd(draw())
+        tb = bits[:, l]
+        s1_even = ((s1[:, 0] & np.uint32(1)) == 0)[:, None]
+        c1 = [draw(), draw()]
+        for b in (0, 1):
+            d = u128.sub128(prf_v(prf_method, s2, b),
+                            prf_v(prf_method, s1, b))
+            d = np.where(s1_even, u128.neg128(d), d)
+            cw2[:, 2 * i + b] = u128.add128(c1[b], d)
+        # fold beta into cw1 at the target branch (after cw2 is fixed)
+        adj = np.where(s1_even, beta_l, u128.neg128(beta_l))
+        c1 = [np.where((tb == b)[:, None], u128.add128(c1[b], adj), c1[b])
+              for b in (0, 1)]
+        for b in (0, 1):
+            cw1[:, 2 * i + b] = c1[b]
+        # step both servers' target-path seeds through this level
+        c1_t = np.where((tb == 1)[:, None], c1[1], c1[0])
+        cw2_t = cw2[rows, 2 * i + tb]
+        n1 = u128.add128(prf_v(prf_method, s1, tb),
+                         np.where(s1_even, c1_t, cw2_t))
+        n2 = u128.add128(prf_v(prf_method, s2, tb),
+                         np.where(s1_even, cw2_t, c1_t))
+        s1, s2 = n1, n2
+
+    return (_wire_batch(cw1, cw2, k1, depth, n),
+            _wire_batch(cw1, cw2, k2, depth, n))
 
 
 def evaluate_flat(key: FlatKey, indx: int, prf_method: int) -> int:

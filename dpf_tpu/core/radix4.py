@@ -250,6 +250,106 @@ def generate_keys_r4(alpha: int, n: int, seed: bytes, prf_method: int,
     return ka, kb
 
 
+def gen_batched_r4(alphas, n: int, seeds=None, *, prf_method: int,
+                   beta: int = 1):
+    """Vectorized two-server mixed-radix keygen over B indices.
+
+    The radix-4 counterpart of ``keygen.gen_batched``: one DRBG squeeze
+    per key, then O(log4 N) vectorized PRF calls over [B, 4] limb
+    tensors.  Bit-identical to ``generate_keys_r4(alphas[i], n,
+    seeds[i])`` per key (the scalar generator stays the fuzz oracle).
+    Returns two [B, 524] int32 wire-key arrays.
+    """
+    from .keygen import _check_batch_args, _wire_batch, drbg_u128_batch
+    from .prf import prf_v
+    alphas, seeds = _check_batch_args(alphas, n, seeds)
+    depth = n.bit_length() - 1
+    if depth > 32:  # sum(arities) = 2*depth must fit MAX_CW
+        raise ValueError("table size 2^%d exceeds max 2^32" % depth)
+    ars = arities(n)
+    offs = cw_offsets(ars)
+    levels = len(ars)
+    bsz = alphas.size
+    n_draws = 2 + (0 if levels == 1 else 1) + ars[0] + sum(
+        (0 if j == levels - 1 else 1) + ars[j] for j in range(1, levels))
+    draws = drbg_u128_batch(seeds, n_draws)
+    cur = 0
+
+    def draw():
+        nonlocal cur
+        v = draws[:, cur, :]
+        cur += 1
+        return v
+
+    def odd(v):
+        v = v.copy()
+        v[:, 0] |= np.uint32(1)
+        return v
+
+    digits = np.empty((bsz, levels), dtype=np.uint32)
+    rem = alphas.copy()
+    for j, a in enumerate(ars):
+        digits[:, j] = rem % a
+        rem //= a
+
+    beta_c = np.broadcast_to(u128.int_to_limbs(beta), (bsz, 4))
+    cw1 = np.zeros((bsz, MAX_CW, 4), dtype=np.uint32)
+    cw2 = np.zeros((bsz, MAX_CW, 4), dtype=np.uint32)
+    rows = np.arange(bsz)
+
+    # --- base level (eval step 0) ---------------------------------------
+    a0 = ars[0]
+    k1 = draw().copy()
+    k1[:, 0] &= np.uint32(0xFFFFFFFE)                 # server 0: LSB 0
+    k2 = odd(draw())                                  # server 1: LSB 1
+    beta_l = beta_c if levels == 1 else odd(draw())
+    tb = digits[:, 0]
+    c1 = [draw() for _ in range(a0)]
+    for b in range(a0):
+        d = u128.sub128(prf_v(prf_method, k1, b), prf_v(prf_method, k2, b))
+        d = np.where((tb == b)[:, None], u128.sub128(d, beta_l), d)
+        cw1[:, offs[0] + b] = c1[b]
+        cw2[:, offs[0] + b] = u128.add128(c1[b], d)
+    c1_t = np.stack(c1, axis=1)[rows, tb]
+    s1 = u128.add128(prf_v(prf_method, k1, tb), c1_t)
+    s2 = u128.add128(prf_v(prf_method, k2, tb), cw2[rows, offs[0] + tb])
+
+    # --- upper levels, bottom to top -------------------------------------
+    for j in range(1, levels):
+        if not ((u128.sub128(s1, s2) == beta_l).all()
+                and (((s1[:, 0] ^ s2[:, 0]) & 1) == 1).all()):
+            raise AssertionError(
+                "radix keygen invariant broken at level %d: seed shares "
+                "must differ by the odd beta' (and so in LSB)" % j)
+        a = ars[j]
+        beta_l = beta_c if j == levels - 1 else odd(draw())
+        tb = digits[:, j]
+        s1_even = ((s1[:, 0] & np.uint32(1)) == 0)[:, None]
+        c1 = [draw() for _ in range(a)]
+        for b in range(a):
+            d = u128.sub128(prf_v(prf_method, s2, b),
+                            prf_v(prf_method, s1, b))
+            d = np.where(s1_even, u128.neg128(d), d)
+            cw2[:, offs[j] + b] = u128.add128(c1[b], d)
+        adj = np.where(s1_even, beta_l, u128.neg128(beta_l))
+        c1 = [np.where((tb == b)[:, None], u128.add128(c1[b], adj), c1[b])
+              for b in range(a)]
+        for b in range(a):
+            cw1[:, offs[j] + b] = c1[b]
+        c1_t = np.stack(c1, axis=1)[rows, tb]
+        cw2_t = cw2[rows, offs[j] + tb]
+        n1 = u128.add128(prf_v(prf_method, s1, tb),
+                         np.where(s1_even, c1_t, cw2_t))
+        n2 = u128.add128(prf_v(prf_method, s2, tb),
+                         np.where(s1_even, cw2_t, c1_t))
+        s1, s2 = n1, n2
+
+    n_bin = sum(1 for a in ars if a == 2)
+    marker = (np.uint32(4), np.uint32(n_bin))
+    return (_wire_batch(cw1, cw2, k1, depth, n, radix_slot0=marker),
+            _wire_batch(cw1, cw2, k2, depth, n, radix_slot0=marker))
+
+
 def evaluate_mixed(key: MixedKey, indx: int, prf_method: int) -> int:
     """Scalar reference evaluation at one index (O(log N) PRF calls)."""
     prf = PRF_FUNCS[prf_method]

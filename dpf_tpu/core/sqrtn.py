@@ -152,6 +152,73 @@ def generate_sqrt_keys(alpha: int, n: int, seed: bytes, prf_method: int,
             SqrtKey(keys=keys2, cw1=cw1, cw2=cw2, **args))
 
 
+def gen_sqrt_batched(alphas, n: int, seeds=None, *, prf_method: int,
+                     beta: int = 1, n_keys: int | None = None):
+    """Vectorized two-server sqrt-N keygen over B independent indices.
+
+    The sqrt-N counterpart of ``keygen.gen_batched``: one DRBG squeeze
+    per key, then ONE vectorized PRF call over the [B, R] target-column
+    grid instead of ``O(B * R)`` Python-int PRF calls.  Bit-identical to
+    ``generate_sqrt_keys(alphas[i], n, seeds[i])`` per key (the scalar
+    generator stays the fuzz oracle).  Returns two
+    [B, (4 + K + 2R) * 4] int32 wire-key arrays.
+    """
+    from .keygen import _check_batch_args, drbg_u128_batch
+    alphas, seeds = _check_batch_args(alphas, n, seeds)
+    k = n_keys or default_split(n)[0]
+    if n % k:
+        raise ValueError("n_keys must divide n")
+    r = n // k
+    bsz = alphas.size
+    j_t = (alphas % k).astype(np.int64)
+    r_t = (alphas // k).astype(np.int64)
+    # draw layout per key: k+1 column draws (the target column consumes
+    # two — its server-1 seed, then server-2's opposite-LSB seed), then
+    # one codeword draw per row — the exact scalar draw order
+    draws = drbg_u128_batch(seeds, k + 1 + r)
+    rows_b = np.arange(bsz)
+    col_idx = np.arange(k)[None, :] + (np.arange(k)[None, :] > j_t[:, None])
+    keys1 = draws[rows_b[:, None], col_idx]           # [B, K, 4]
+    keys2 = keys1.copy()
+    s1v = keys1[rows_b, j_t]                          # [B, 4]
+    d2 = draws[rows_b, j_t + 1].copy()
+    d2[:, 0] = ((d2[:, 0] & np.uint32(0xFFFFFFFE))
+                | (np.uint32(1) ^ (s1v[:, 0] & np.uint32(1))))
+    keys2[rows_b, j_t] = d2
+    s2v = d2
+
+    from .prf import prf_v
+    rows = np.arange(r, dtype=np.uint32)
+    p1 = prf_v(prf_method,
+               np.ascontiguousarray(np.broadcast_to(
+                   s1v[:, None, :], (bsz, r, 4))), rows)
+    p2 = prf_v(prf_method,
+               np.ascontiguousarray(np.broadcast_to(
+                   s2v[:, None, :], (bsz, r, 4))), rows)
+    diff = u128.sub128(p1, p2)                        # [B, R, 4]
+    beta_c = np.broadcast_to(u128.int_to_limbs(beta), (bsz, 4))
+    tmask = (rows[None, :] == r_t[:, None])[..., None]
+    diff = np.where(tmask, u128.sub128(diff, beta_c[:, None, :]), diff)
+    s1_even = ((s1v[:, 0] & np.uint32(1)) == 0)[:, None, None]
+    diff = np.where(s1_even, diff, u128.neg128(diff))
+    c1 = draws[:, k + 1:]                             # [B, R, 4]
+    cw1 = c1
+    cw2 = u128.add128(c1, diff)
+
+    def wire(key_seeds, cw1, cw2):
+        slots = np.zeros((bsz, 4 + k + 2 * r, 4), dtype=np.uint32)
+        slots[:, 0, 0] = np.uint32(k)
+        slots[:, 1, 0] = np.uint32(r)
+        slots[:, 2, 0] = np.uint32(n & 0xFFFFFFFF)
+        slots[:, 2, 1] = np.uint32(n >> 32)
+        slots[:, 4:4 + k] = key_seeds
+        slots[:, 4 + k:4 + k + r] = cw1
+        slots[:, 4 + k + r:] = cw2
+        return slots.reshape(bsz, -1).view(np.int32)
+
+    return wire(keys1, cw1, cw2), wire(keys2, cw1, cw2)
+
+
 def _grid_vals(prf_method: int, seeds_row, r: int, xp,
                row0=np.uint32(0)):
     """PRF values over rows row0..row0+r-1 for a seed tensor broadcast
@@ -291,6 +358,20 @@ def stack_sqrt_wire_keys(keys) -> np.ndarray:
         return stack_wire_keys(keys, words=None)
     except ValueError:
         raise ValueError("keys for mixed sqrt-N splits") from None
+
+
+def sqrt_wire_ns(arr: np.ndarray) -> np.ndarray:
+    """Per-key table size n from a stacked [B, W] sqrt-N wire buffer
+    (header slot 2, limbs 0/1), with the width sanity check a header
+    read needs.  The one wire-header reader outside the decoder —
+    exported so batch callers can attribute a wrong-domain key to its
+    batch position before the full decode."""
+    if arr.shape[1] % 4 or arr.shape[1] < 16:
+        raise ValueError("malformed sqrt-N key: %d int32 words"
+                         % arr.shape[1])
+    slots = arr.view(np.uint32).reshape(arr.shape[0], -1, 4)
+    return (slots[:, 2, 0].astype(np.int64)
+            | (slots[:, 2, 1].astype(np.int64) << 32))
 
 
 def decode_sqrt_keys_batched(keys) -> PackedSqrtKeys:
@@ -435,6 +516,26 @@ def _eval_contract_batched_jit(seeds, cw1, cw2, table, *, prf_method,
     return acc
 
 
+def _resolve_row_chunk(r: int, k: int, bsz: int,
+                       row_chunk: int | None) -> int:
+    """The one row_chunk policy for the fused sqrt-N entry points:
+    None -> the ``choose_row_chunk`` heuristic; explicit values must
+    divide R and — when actually chunking — be a multiple of
+    ``ROW_CHUNK_FLOOR`` so the block-PRG 4-row interleave in
+    ``_grid_vals`` stays intact."""
+    if row_chunk is None:
+        row_chunk = choose_row_chunk(r, k, bsz)
+    row_chunk = int(row_chunk)
+    if row_chunk < 1 or r % row_chunk:
+        raise ValueError("row_chunk (%d) must divide R=%d"
+                         % (row_chunk, r))
+    if row_chunk < r and row_chunk % ROW_CHUNK_FLOOR:
+        raise ValueError(
+            "row_chunk (%d) must be a multiple of 4 when chunking (the "
+            "block-PRG ids interleave 4 rows per core block)" % row_chunk)
+    return row_chunk
+
+
 def eval_contract_batched(seeds, cw1, cw2, table, *, prf_method: int,
                           dot_impl: str = "i32",
                           row_chunk: int | None = None):
@@ -458,19 +559,82 @@ def eval_contract_batched(seeds, cw1, cw2, table, *, prf_method: int,
     """
     bsz, k = seeds.shape[0], seeds.shape[1]
     r = cw1.shape[1]
-    if row_chunk is None:
-        row_chunk = choose_row_chunk(r, k, bsz)
-    row_chunk = int(row_chunk)
-    if row_chunk < 1 or r % row_chunk:
-        raise ValueError("row_chunk (%d) must divide R=%d"
-                         % (row_chunk, r))
-    if row_chunk < r and row_chunk % ROW_CHUNK_FLOOR:
-        raise ValueError(
-            "row_chunk (%d) must be a multiple of 4 when chunking (the "
-            "block-PRG ids interleave 4 rows per core block)" % row_chunk)
+    row_chunk = _resolve_row_chunk(r, k, bsz, row_chunk)
     return _eval_contract_batched_jit(
         jnp.asarray(seeds), jnp.asarray(cw1), jnp.asarray(cw2), table,
         prf_method=prf_method, dot_impl=dot_impl, row_chunk=row_chunk)
+
+
+@functools.partial(jax.jit, static_argnames=("prf_method", "dot_impl",
+                                             "row_chunk"))
+def _eval_contract_pkt_jit(seeds, cw1, cw2, tables, *, prf_method,
+                           dot_impl, row_chunk):
+    from ..ops import matmul128
+
+    bsz, k, _ = seeds.shape
+    r = cw1.shape[1]
+    e = tables.shape[-1]
+    rc = row_chunk
+    steps = r // rc
+    sel = (seeds[:, None, :, 0] & np.uint32(1)).astype(bool)[..., None]
+
+    def slab(row0, c1, c2):
+        """One [B, rc, K] grid chunk -> [B, rc*K] int32 leaf shares."""
+        vals = _grid_vals(
+            prf_method,
+            lambda nr: jnp.broadcast_to(seeds[:, None, :, :],
+                                        (bsz, nr, k, 4)),
+            rc, jnp, row0=row0)                       # [B, rc, K, 4]
+        cw = jnp.where(sel, c2[:, :, None, :], c1[:, :, None, :])
+        out = u128.add128(vals, cw)
+        return out[..., 0].astype(jnp.int32).reshape(bsz, rc * k)
+
+    def bdot(leaves, chunk):
+        # [B, C] x [B, C, E] -> [B, E], batched over keys, mod 2^32
+        if (dot_impl or "i32") == "i32":
+            return jax.lax.dot_general(
+                leaves, chunk, (((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.int32)
+        return jax.vmap(lambda a, t: matmul128.dot(a[None, :], t,
+                                                   dot_impl)[0])(leaves,
+                                                                 chunk)
+
+    if steps == 1:  # grid fits the budget — no scan machinery at all
+        return bdot(slab(np.uint32(0), cw1, cw2), tables)
+
+    def body(acc, inp):
+        row0, c1, c2, tbl = inp
+        return acc + bdot(slab(row0, c1, c2), tbl), None
+
+    acc, _ = jax.lax.scan(
+        body, jnp.zeros((bsz, e), jnp.int32),
+        (jnp.arange(steps, dtype=jnp.uint32) * jnp.uint32(rc),
+         jnp.moveaxis(cw1.reshape(bsz, steps, rc, 4), 1, 0),
+         jnp.moveaxis(cw2.reshape(bsz, steps, rc, 4), 1, 0),
+         jnp.moveaxis(tables.reshape(bsz, steps, rc * k, e), 1, 0)))
+    return acc
+
+
+def eval_contract_per_key_tables(seeds, cw1, cw2, tables, *,
+                                 prf_method: int, dot_impl: str = "i32",
+                                 row_chunk: int | None = None):
+    """Fused batched sqrt-N evaluation where every key has its OWN table.
+
+    tables: [B, N, E] int32 in NATURAL order (the grid emits natural
+    order — no permutation, unlike the logn per-key-tables paths).
+    Returns [B, E] int32: ``out[b] = sum_x leaf32[b, x] * tables[b, x]``
+    mod 2^32.  This is the sqrt-N construction's batch-PIR surface (one
+    device dispatch answers one query round across all equal-sized
+    bins), mirroring ``expand.expand_and_contract_per_key_tables``;
+    ``row_chunk`` follows the same rules as ``eval_contract_batched``.
+    """
+    bsz, k = seeds.shape[0], seeds.shape[1]
+    r = cw1.shape[1]
+    row_chunk = _resolve_row_chunk(r, k, bsz, row_chunk)
+    return _eval_contract_pkt_jit(
+        jnp.asarray(seeds), jnp.asarray(cw1), jnp.asarray(cw2),
+        jnp.asarray(tables), prf_method=prf_method, dot_impl=dot_impl,
+        row_chunk=row_chunk)
 
 
 # ------------------------------------------------------ point evaluation

@@ -1,0 +1,291 @@
+"""End-to-end batch-PIR benchmark: plan -> keygen -> answer -> recover.
+
+Measures the production batch-PIR path (this PR) against the pre-PR
+machinery on the identical planned workload, equality-gated before any
+timing:
+
+* **keygen** — ``PrivateLookupClient.make_queries`` (one vectorized
+  ``gen_batched`` call per (n, G) size group) vs ``make_queries_scalar``
+  (the per-bin ``DPF.gen`` Python loop), byte-identical keys under
+  pinned DRBG seeds.
+* **answer** — ``PrivateLookupServer.answer`` (packed wire codecs,
+  tuning-cache knobs, every size group dispatched asynchronously before
+  one blocking gather) vs ``answer_scalar`` (per-key deserialize,
+  frozen heuristics, per-group host sync), bit-identical shares.
+* **end-to-end** — keygen -> answer(A) + answer(B) -> recover over
+  ``rounds`` query rounds, both paths.
+* **streaming** — the same rounds pipelined through ``LookupStream``
+  (one ServingEngine per size group) on both servers.
+
+Runs fine on ``JAX_PLATFORMS=cpu`` (the keygen and ingest levers are
+host-side; on TPU the async group dispatch and the stream's in-flight
+window add device overlap on top).
+
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python benchmark.py --batch-pir [--out BENCH_PIR_r09.json]
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _workload(entries, entry_size, bin_fraction, seed=0):
+    """Deterministic planned workload: a table, access patterns binning
+    EVERY entry (chunked coverage patterns — the planner only bins
+    indices it has seen), and the optimizer's plan over them."""
+    from ..apps.batch_pir import (BatchPIROptimize, CollocateConfig,
+                                  HotColdConfig, PIRConfig)
+    rng = np.random.default_rng(seed)
+    table = rng.integers(0, 2 ** 31, (entries, entry_size),
+                         dtype=np.int64).astype(np.int32)
+    cover = [list(range(i, min(i + 512, entries)))
+             for i in range(0, entries, 512)]
+    opt = BatchPIROptimize(
+        cover, cover, HotColdConfig(1.0), CollocateConfig(0),
+        PIRConfig(bin_fraction=bin_fraction, queries_to_hot=1))
+    return table, opt
+
+
+def _wanted_rounds(opt, entries, rounds, seed=1):
+    """One needed-index batch per round (zipf-ish popularity)."""
+    rng = np.random.default_rng(seed)
+    pop = 1.0 / np.arange(1, entries + 1)
+    pop /= pop.sum()
+    want = max(1, len(opt.hot_table_bins) // 2)
+    return [[int(x) for x in rng.choice(entries, size=want, p=pop)]
+            for _ in range(rounds)]
+
+
+def pir_point(entries=32768, entry_size=16, bin_fraction=1 / 256.,
+              prf=None, scheme="logn", radix=2, rounds=6, reps=3,
+              quiet=False):
+    """Benchmark one batch-PIR deployment point; returns the point dict.
+
+    Every timed candidate is equality-gated against the scalar oracles
+    first: batched keys vs the per-bin gen loop (pinned seeds), the
+    packed/tuned/async ``answer`` vs ``answer_scalar``, streaming
+    results vs ``answer``, and the recovered rows vs the table itself.
+    """
+    from ..apps.batch_pir import PrivateLookupClient, PrivateLookupServer
+    from ..core.prf_ref import PRF_CHACHA20, PRF_NAMES
+
+    if prf is None:
+        prf = PRF_CHACHA20          # a real cipher: the scalar per-bin
+        #       gen loop pays Python-int PRF calls, the regime the
+        #       vectorized keygen targets
+    t0 = time.perf_counter()
+    table, opt = _workload(entries, entry_size, bin_fraction)
+    plan_s = time.perf_counter() - t0
+
+    server_a = PrivateLookupServer(table, opt.hot_table_bins, prf=prf,
+                                   radix=radix, scheme=scheme)
+    server_b = PrivateLookupServer(table, opt.hot_table_bins, prf=prf,
+                                   radix=radix, scheme=scheme)
+    client = PrivateLookupClient(opt.hot_table_bins, server_a.bin_sizes,
+                                 prf=prf, radix=radix, scheme=scheme,
+                                 entry_size=entry_size)
+    n_bins = len(server_a.bins)
+    rounds_w = _wanted_rounds(opt, entries, rounds)
+
+    # ---- equality gates (never timed) --------------------------------
+    seeds = [b"bench-pir-%d" % i for i in range(n_bins)]
+    ka, kb, plan = client.make_queries(rounds_w[0], seeds=seeds)
+    ka_s, kb_s, plan_s2 = client.make_queries_scalar(rounds_w[0],
+                                                     seeds=seeds)
+    assert plan == plan_s2, "batched plan diverged from the scalar loop"
+    for a, b in zip(ka + kb, ka_s + kb_s):
+        if not np.array_equal(np.asarray(a), np.asarray(b)):
+            raise AssertionError("batched keygen diverged from the "
+                                 "per-bin gen loop")
+    ans_a = server_a.answer(ka)
+    if not np.array_equal(ans_a, server_a.answer_scalar(ka)):
+        raise AssertionError("packed answer diverged from answer_scalar")
+    got = client.recover(ans_a, server_b.answer(kb), plan)
+    for w, row in got.items():
+        if not np.array_equal(row, table[w]):
+            raise AssertionError("recovered row %d mismatches the table"
+                                 % w)
+
+    # ---- keygen: batched vs per-bin loop -----------------------------
+    best_b = best_s = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        client.make_queries(rounds_w[0])
+        best_b = min(best_b, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        client.make_queries_scalar(rounds_w[0])
+        best_s = min(best_s, time.perf_counter() - t0)
+    keygen = {"bins": n_bins, "scalar_s": round(best_s, 6),
+              "batched_s": round(best_b, 6),
+              "speedup": round(best_s / best_b, 2)}
+
+    # ---- answer: packed/tuned/async vs scalar/per-group-sync ---------
+    best_n = best_s = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        server_a.answer(ka)
+        best_n = min(best_n, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        server_a.answer_scalar(ka)
+        best_s = min(best_s, time.perf_counter() - t0)
+    answer = {"scalar_s": round(best_s, 6), "batched_s": round(best_n, 6),
+              "speedup": round(best_s / best_n, 2),
+              "size_groups": {str(n): len(g.idxs)
+                              for n, g in server_a._groups.items()}}
+
+    # ---- end-to-end: keygen -> answer x2 -> recover over all rounds --
+    def e2e(batched: bool) -> float:
+        t0 = time.perf_counter()
+        for wanted in rounds_w:
+            if batched:
+                a, b, p = client.make_queries(wanted)
+                client.recover(server_a.answer(a), server_b.answer(b), p)
+            else:
+                a, b, p = client.make_queries_scalar(wanted)
+                client.recover(server_a.answer_scalar(a),
+                               server_b.answer_scalar(b), p)
+        return time.perf_counter() - t0
+
+    e2e_new = min(e2e(True) for _ in range(max(1, reps - 1)))
+    e2e_old = min(e2e(False) for _ in range(max(1, reps - 1)))
+    total_q = n_bins * rounds
+
+    # ---- streaming: LookupStream rounds vs sequential answer() -------
+    st_a = server_a.stream(max_in_flight=2, warmup=True)
+    st_b = server_b.stream(max_in_flight=2, warmup=True)
+    key_rounds = [client.make_queries(w) for w in rounds_w]
+    futs = [(st_a.submit(a), st_b.submit(b), p)
+            for a, b, p in key_rounds]  # warm + gate pass
+    st_a.drain(), st_b.drain()
+    for (fa, fb, p), (a, b, _) in zip(futs, key_rounds):
+        if not (np.array_equal(fa.result(), server_a.answer(a))
+                and np.array_equal(fb.result(), server_b.answer(b))):
+            raise AssertionError("streaming answers diverged from "
+                                 "answer()")
+    t0 = time.perf_counter()
+    futs = [(st_a.submit(a), st_b.submit(b), p) for a, b, p in key_rounds]
+    for fa, fb, p in futs:
+        client.recover(fa.result(), fb.result(), p)
+    stream_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for a, b, p in key_rounds:
+        client.recover(server_a.answer(a), server_b.answer(b), p)
+    seq_s = time.perf_counter() - t0
+
+    point = {
+        "entries": entries, "entry_size": entry_size,
+        "bin_fraction": bin_fraction, "bins": n_bins,
+        "rounds": rounds, "prf": PRF_NAMES[prf],
+        "scheme": scheme, "radix": radix,
+        "plan_s": round(plan_s, 4),
+        "keygen": keygen,
+        "answer": answer,
+        "e2e": {"scalar_s": round(e2e_old, 4),
+                "batched_s": round(e2e_new, 4),
+                "speedup": round(e2e_old / e2e_new, 2),
+                "batched_qps": int(total_q / e2e_new),
+                "scalar_qps": int(total_q / e2e_old)},
+        "streaming": {"stream_s": round(stream_s, 4),
+                      "sequential_s": round(seq_s, 4),
+                      "speedup": round(seq_s / stream_s, 2),
+                      "qps": int(total_q / stream_s),
+                      "stats": st_a.stats()},
+        "group_constructions": {
+            str(n): list(c)
+            for n, c in server_a.group_constructions().items()},
+    }
+    if not quiet:
+        print(json.dumps(point), flush=True)
+    return point
+
+
+DEFAULT_POINTS = (
+    # 256 bins x 128 entries on the radix-4 construction: the >=256-bin
+    # keygen regime where the vectorized generator replaces a pure-
+    # Python per-bin loop (the binary scheme also has the native C++
+    # generator, which gen_batched_binary already routes through)
+    {"entries": 32768, "bin_fraction": 1 / 256., "radix": 4},
+    # binary wire-compatible point with an uneven split -> two size
+    # groups (512-entry bins + a remainder bin): exercises the
+    # multi-group async dispatch
+    {"entries": 4096, "bin_fraction": 0.1, "radix": 2},
+)
+
+
+def pir_bench(points=None, *, prf=None, scheme=None, radix=None,
+              rounds=6, reps=3, out=None, quiet=False) -> dict:
+    """``benchmark.py --batch-pir``: run every point, emit ONE JSON
+    record (committed as ``BENCH_PIR_r09.json``), headline = the largest
+    point's end-to-end throughput vs the pre-PR path.  Per-point dicts
+    may pin ``scheme``/``radix`` (the defaults race the radix-4 and
+    binary constructions); an EXPLICIT caller scheme/radix overrides
+    the per-point pins wholesale."""
+    override = {}
+    if scheme is not None:
+        override["scheme"] = scheme
+        override["radix"] = 2 if scheme == "sqrtn" else (radix or 2)
+    elif radix is not None:
+        override["radix"] = radix
+    pts = [pir_point(prf=prf, rounds=rounds, reps=reps, quiet=True,
+                     **{"scheme": "logn", "radix": 2, **p, **override})
+           for p in (points or DEFAULT_POINTS)]
+    head = max(pts, key=lambda p: p["entries"])
+    record = {
+        "metric": "end-to-end batch-PIR (plan->keygen->answer->recover, "
+                  "%d bins x %d rounds, entries=%d, %s, 1 device)"
+                  % (head["bins"], head["rounds"], head["entries"],
+                     head["prf"]),
+        "value": head["e2e"]["batched_qps"],
+        "unit": "bin-queries/sec",
+        "vs_baseline": round(head["e2e"]["scalar_s"]
+                             / head["e2e"]["batched_s"], 4),
+        "baseline": "pre-PR batch-PIR path: per-bin DPF.gen loop + "
+                    "per-key deserialize + heuristic knobs + per-group "
+                    "host sync, identical plan and seeds",
+        "points": pts,
+        "checked": True,  # every timed candidate passed the scalar-
+        #                   oracle equality gates first
+    }
+    if not quiet:
+        print(json.dumps(record), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    return record
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--entries", type=int, default=None,
+                    help="single point: table entries (default: the "
+                         "two-point default sweep)")
+    ap.add_argument("--bin-fraction", type=float, default=1 / 256.)
+    ap.add_argument("--prf", type=int, default=None,
+                    help="PRF id (default 2=ChaCha20)")
+    ap.add_argument("--scheme", default=None,
+                    choices=("logn", "sqrtn", "auto"),
+                    help="override every point's construction (default: "
+                         "the per-point pins)")
+    ap.add_argument("--radix", type=int, default=None, choices=(2, 4))
+    ap.add_argument("--rounds", type=int, default=6)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", help="also write the JSON record to a file")
+    args = ap.parse_args(argv)
+    points = None
+    if args.entries:
+        points = [{"entries": args.entries,
+                   "bin_fraction": args.bin_fraction}]
+    return pir_bench(points, prf=args.prf, scheme=args.scheme,
+                     radix=args.radix, rounds=args.rounds, reps=args.reps,
+                     out=args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -68,6 +68,16 @@ def heuristic_knobs(n: int, batch: int, *, prf_method: int,
     }
 
 
+def heuristic_scheme(n: int) -> dict:
+    """Cold-cache construction default for ``DPF(scheme="auto")`` and
+    the batch-PIR per-group resolution: the reference-wire-compatible
+    binary GGM tree.  Deliberately conservative — the measured winner
+    per shape lives in the tuning cache (``scheme_sweep`` populates it,
+    ``tune.lookup_scheme`` answers); until a sweep has run on this
+    machine the auto mode must not silently switch key formats."""
+    return {"scheme": "logn", "radix": 2}
+
+
 def stage_candidates(stage: str, current: dict, *, n: int, batch: int,
                      prf_method: int, radix: int = 2,
                      backend: str | None = None) -> list:

@@ -22,6 +22,21 @@ def is_auto(value) -> bool:
     return value is None or value == "auto"
 
 
+def check_construction(scheme: str, radix: int,
+                       schemes=("logn", "sqrtn", "auto")) -> None:
+    """The one scheme/radix membership rule for every construction
+    surface — the ``DPF`` ctor and the batch-PIR server, client, and
+    cost model all validate here.  Pass a narrower ``schemes`` tuple to
+    drop "auto" at call sites that need a concrete construction."""
+    if scheme not in schemes:
+        raise ValueError("scheme must be one of %s (got %r)"
+                         % (schemes, scheme))
+    if radix not in (2, 4):
+        raise ValueError("radix must be 2 or 4")
+    if scheme == "sqrtn" and radix == 4:
+        raise ValueError("scheme='sqrtn' has no radix; use radix=2")
+
+
 @dataclass(frozen=True)
 class EvalConfig:
     """Everything that selects a compiled evaluation program."""

@@ -215,3 +215,129 @@ def test_non_pow2_batch_size_chunking():
     b = np.asarray(dpf.eval_tpu(list(k2s)))
     rec = (a - b).astype(np.int32)
     assert (rec == table[idxs]).all()
+
+
+# ---------------------------------------------------- scheme="auto"
+
+def test_scheme_auto_cold_cache_falls_back_to_heuristic():
+    """With no tuning-cache entry the auto mode must resolve to the
+    conservative heuristic (binary GGM) at first use."""
+    dpf = DPF(prf=0, scheme="auto")
+    assert dpf.scheme == "auto" and dpf.scheme_resolved_from is None
+    table = np.arange(256 * 16, dtype=np.int32).reshape(256, 16)
+    dpf.eval_init(table)
+    assert (dpf.scheme, dpf.radix) == ("logn", 2)
+    assert dpf.scheme_resolved_from == "heuristic"
+    k1, k2 = dpf.gen(3, 256)
+    out = (np.asarray(dpf.eval_tpu([k1]), np.int64)
+           - np.asarray(dpf.eval_tpu([k2]), np.int64)).astype(np.int32)
+    assert np.array_equal(out[0], table[3])
+
+
+def test_scheme_auto_picks_cached_winner(tmp_path, monkeypatch):
+    """A seeded scheme-sweep cache entry (the BENCH_SCHEME_r08 shape of
+    result: sqrtn wins) must be what scheme='auto' resolves to — the
+    ROADMAP loop-closure this PR ships."""
+    from dpf_tpu.tune import cache as tcache
+    from dpf_tpu.tune.search import scheme_cache_key
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    c = tcache.default_cache(refresh=True)
+    c.store(scheme_cache_key(n=256, entry_size=16, batch=512,
+                             prf_method=0),
+            {"knobs": {"scheme": "sqrtn", "radix": 2,
+                       "construction": "sqrtn"}})
+    dpf = DPF(prf=0, scheme="auto")
+    table = np.arange(256 * 16, dtype=np.int32).reshape(256, 16)
+    dpf.eval_init(table)
+    assert (dpf.scheme, dpf.radix) == ("sqrtn", 2)
+    assert dpf.scheme_resolved_from == "cache"
+    k1, k2 = dpf.gen(7, 256)
+    out = (np.asarray(dpf.eval_tpu([k1]), np.int64)
+           - np.asarray(dpf.eval_tpu([k2]), np.int64)).astype(np.int32)
+    assert np.array_equal(out[0], table[7])
+    # a radix-4 winner resolves the radix too
+    c.store(scheme_cache_key(n=512, entry_size=16, batch=512,
+                             prf_method=0),
+            {"knobs": {"scheme": "logn", "radix": 4,
+                       "construction": "radix4"}})
+    dpf4 = DPF(prf=0, scheme="auto")
+    dpf4.eval_init(np.zeros((512, 16), np.int32))
+    assert (dpf4.scheme, dpf4.radix) == ("logn", 4)
+
+
+def test_scheme_auto_resolution_is_sticky(tmp_path, monkeypatch):
+    """gen before eval_init pins the construction; a later eval_init
+    must not silently switch it (keys are already minted)."""
+    from dpf_tpu.tune import cache as tcache
+    from dpf_tpu.tune.search import scheme_cache_key
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    c = tcache.default_cache(refresh=True)
+    c.store(scheme_cache_key(n=256, entry_size=16, batch=512,
+                             prf_method=0),
+            {"knobs": {"scheme": "sqrtn", "radix": 2,
+                       "construction": "sqrtn"}})
+    dpf = DPF(prf=0, scheme="auto")
+    dpf.gen(0, 256)
+    assert dpf.scheme == "sqrtn"
+    c.store(scheme_cache_key(n=256, entry_size=16, batch=512,
+                             prf_method=0),
+            {"knobs": {"scheme": "logn", "radix": 2,
+                       "construction": "logn"}})
+    dpf.eval_init(np.zeros((256, 16), np.int32))
+    assert dpf.scheme == "sqrtn"  # first resolution wins
+
+
+def test_scheme_auto_rejects_explicit_radix4():
+    from dpf_tpu.utils.config import EvalConfig
+    with pytest.raises(ValueError):
+        DPF(config=EvalConfig(prf_method=0, radix=4), scheme="auto")
+
+
+# ----------------------------------------------------- list-input gen
+
+@pytest.mark.parametrize("scheme,radix", [("logn", 2), ("logn", 4),
+                                          ("sqrtn", 2)])
+def test_gen_list_input_matches_scalar(scheme, radix):
+    """DPF.gen with a list of indices returns [B, W] key tensors whose
+    rows are bit-identical to the scalar calls under pinned seeds, for
+    every construction."""
+    from dpf_tpu.utils.config import EvalConfig
+    if radix == 4:
+        dpf = DPF(config=EvalConfig(prf_method=0, radix=4))
+    else:
+        dpf = DPF(prf=0, scheme=scheme)
+    n, idxs = 256, [0, 3, 17, 255]
+    seeds = [b"gl-%d" % i for i in range(len(idxs))]
+    wa, wb = dpf.gen(idxs, n, seed=seeds)
+    assert np.asarray(wa).shape[0] == len(idxs)
+    for i, x in enumerate(idxs):
+        sa, sb = dpf.gen(x, n, seed=seeds[i])
+        assert np.array_equal(np.asarray(wa[i]), np.asarray(sa))
+        assert np.array_equal(np.asarray(wb[i]), np.asarray(sb))
+    # batched rows evaluate like scalar keys on the device path
+    table = np.arange(n * 16, dtype=np.int32).reshape(n, 16)
+    dpf.eval_init(table)
+    out = (np.asarray(dpf.eval_tpu(list(wa)), np.int64)
+           - np.asarray(dpf.eval_tpu(list(wb)), np.int64)).astype(np.int32)
+    assert np.array_equal(out, table[idxs])
+
+
+def test_scheme_auto_entry_size_hint(tmp_path, monkeypatch):
+    """A keygen-only auto client resolves with the ctor's entry_size
+    hint (the cache key includes the table width the SERVER sees)."""
+    from dpf_tpu.tune import cache as tcache
+    from dpf_tpu.tune.search import scheme_cache_key
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    c = tcache.default_cache(refresh=True)
+    c.store(scheme_cache_key(n=256, entry_size=64, batch=512,
+                             prf_method=0),
+            {"knobs": {"scheme": "sqrtn", "radix": 2,
+                       "construction": "sqrtn"}})
+    d = DPF(prf=0, scheme="auto", entry_size=64)
+    d.gen(0, 256)
+    assert d.scheme == "sqrtn"          # hinted lookup hit the winner
+    d16 = DPF(prf=0, scheme="auto")
+    d16.gen(0, 256)
+    assert d16.scheme == "logn"         # default-width lookup misses
+    with pytest.raises(ValueError):
+        DPF(prf=0, entry_size=64)       # hint only parameterizes auto

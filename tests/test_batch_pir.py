@@ -1,5 +1,7 @@
 """Batch-PIR optimizer tests + real end-to-end private batched lookup."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -86,9 +88,42 @@ def test_collocate_cache_roundtrip(tmp_path):
 
 
 def test_dpf_key_cost_model():
+    """The cost model prices EXACT wire bytes per construction (the
+    pre-PR model used the reference's analytic 16*4*log2 n, which no
+    real key matches byte-for-byte)."""
     assert batch_pir.dpf_key_cost_bytes(0) == 0
-    assert batch_pir.dpf_key_cost_bytes(1) == 0
-    assert batch_pir.dpf_key_cost_bytes(1 << 20) == 16 * 4 * 20
+    # a single-entry bin still transmits a full key over the padded
+    # 128-entry floor the servers actually evaluate
+    assert batch_pir.dpf_key_cost_bytes(1) == 524 * 4
+    # both logn radices ship the fixed 524-int32 container
+    assert batch_pir.dpf_key_cost_bytes(1 << 20) == 524 * 4
+    assert batch_pir.dpf_key_cost_bytes(1 << 20, "logn", 4) == 524 * 4
+    # sqrt-N keys are O(sqrt N): (4 + K + 2R) slots of 16 B
+    assert batch_pir.dpf_key_cost_bytes(1 << 20, "sqrtn") \
+        == (4 + 1024 + 2 * 1024) * 16
+    with pytest.raises(ValueError):
+        batch_pir.dpf_key_cost_bytes(128, "auto")  # resolve before costing
+    with pytest.raises(ValueError):
+        batch_pir.dpf_key_cost_bytes(128, "logn", 3)
+
+
+def test_dpf_key_cost_model_matches_real_keys():
+    """Fuzz: the model equals the serialized byte count of REAL keys
+    generated over the same padded bin domain, for every construction."""
+    from dpf_tpu.core import keygen, radix4, sqrtn
+    rng = np.random.default_rng(5)
+    for _ in range(6):
+        size = int(rng.integers(1, 3000))
+        n = batch_pir._pad_pow2(size)
+        alpha = int(rng.integers(0, size))
+        k0, _ = keygen.generate_keys(alpha, n, b"c", 0)
+        assert batch_pir.dpf_key_cost_bytes(size) == k0.serialize().nbytes
+        m0, _ = radix4.generate_keys_r4(alpha, n, b"c", 0)
+        assert batch_pir.dpf_key_cost_bytes(size, "logn", 4) \
+            == m0.serialize().nbytes
+        s0, _ = sqrtn.generate_sqrt_keys(alpha, n, b"c", 0)
+        assert batch_pir.dpf_key_cost_bytes(size, "sqrtn") \
+            == s0.serialize().nbytes
 
 
 def test_private_lookup_end_to_end():
@@ -195,3 +230,285 @@ def test_private_lookup_mesh_parallel():
         got = client.recover(a_mesh, meshed.answer(kb), plan)
         for w in wanted:
             assert w in got and (got[w] == table[w]).all(), (radix, w)
+
+
+# ----------------------------------------------- production-path parity
+
+def _setup_lookup(scheme="logn", radix=2, prf=DPF.PRF_DUMMY, n=300, e=4,
+                  bin_fraction=0.34):
+    table = np.random.default_rng(9).integers(
+        0, 2 ** 31, (n, e), dtype=np.int64).astype(np.int32)
+    train = _access_patterns(n_entries=n, seed=3)
+    opt = BatchPIROptimize(
+        train, train, HotColdConfig(1.0), CollocateConfig(0),
+        PIRConfig(bin_fraction=bin_fraction, queries_to_hot=1))
+    sa = PrivateLookupServer(table, opt.hot_table_bins, prf=prf,
+                             radix=radix, scheme=scheme)
+    sb = PrivateLookupServer(table, opt.hot_table_bins, prf=prf,
+                             radix=radix, scheme=scheme)
+    cl = PrivateLookupClient(opt.hot_table_bins, sa.bin_sizes, prf=prf,
+                             radix=radix, scheme=scheme, entry_size=e)
+    return table, opt, sa, sb, cl
+
+
+@pytest.mark.parametrize("scheme,radix", [("logn", 2), ("logn", 4),
+                                          ("sqrtn", 2)])
+def test_batched_paths_match_scalar_oracles(scheme, radix):
+    """The production path (batched keygen, packed group decode, tuned
+    knobs, async group dispatch) is bit-identical to the scalar
+    oracles, per construction."""
+    prf = DPF.PRF_DUMMY if radix == 2 else DPF.PRF_CHACHA20
+    table, opt, sa, sb, cl = _setup_lookup(scheme, radix, prf)
+    wanted = [sorted(b)[0] for b in opt.hot_table_bins[:3]]
+    seeds = [b"par-%d" % i for i in range(len(sa.bins))]
+    ka, kb, plan = cl.make_queries(wanted, seeds=seeds)
+    ka_s, kb_s, plan_s = cl.make_queries_scalar(wanted, seeds=seeds)
+    assert plan == plan_s
+    for a, b in zip(ka + kb, ka_s + kb_s):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    ans = sa.answer(ka)
+    assert np.array_equal(ans, sa.answer_scalar(ka))
+    got = cl.recover(ans, sb.answer(kb), plan)
+    for w in wanted:
+        assert w in got and (got[w] == table[w]).all()
+
+
+def test_private_lookup_end_to_end_sqrtn():
+    """The bin protocol served by the sqrt-N construction (natural-order
+    bin tables, O(sqrt n) keys, per-key-tables grid eval)."""
+    table, opt, sa, sb, cl = _setup_lookup("sqrtn", 2, DPF.PRF_CHACHA20)
+    assert set(sa.group_constructions().values()) == {("sqrtn", 2)}
+    wanted = [sorted(b)[0] for b in opt.hot_table_bins[:3]]
+    ka, kb, plan = cl.make_queries(wanted)
+    got = cl.recover(sa.answer(ka), sb.answer(kb), plan)
+    for w in wanted:
+        assert w in got and (got[w] == table[w]).all()
+
+
+def test_scheme_auto_group_resolution(tmp_path, monkeypatch):
+    """scheme='auto': cold cache falls back to the explicit logn/radix
+    construction; a seeded scheme-sweep winner flips the (n, G) group
+    to sqrtn on BOTH client and server."""
+    from dpf_tpu.tune import cache as tcache
+    from dpf_tpu.tune.search import scheme_cache_key
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    tcache.default_cache(refresh=True)
+    table, opt, sa, sb, cl = _setup_lookup("auto")
+    assert set(sa.group_constructions().values()) == {("logn", 2)}
+    assert cl.group_constructions() == sa.group_constructions()
+
+    c = tcache.default_cache(refresh=True)
+    (n_bin,) = set(sa.bin_sizes)
+    g = len(sa.bins)
+    from dpf_tpu.core.u128 import next_pow2
+    c.store(scheme_cache_key(n=n_bin, entry_size=4, batch=next_pow2(g),
+                             prf_method=DPF.PRF_DUMMY),
+            {"knobs": {"scheme": "sqrtn", "radix": 2,
+                       "construction": "sqrtn"}})
+    table, opt, sa, sb, cl = _setup_lookup("auto")
+    assert set(sa.group_constructions().values()) == {("sqrtn", 2)}
+    assert cl.group_constructions() == sa.group_constructions()
+    wanted = [sorted(b)[0] for b in opt.hot_table_bins[:3]]
+    ka, kb, plan = cl.make_queries(wanted)
+    got = cl.recover(sa.answer(ka), sb.answer(kb), plan)
+    for w in wanted:
+        assert w in got and (got[w] == table[w]).all()
+
+
+# --------------------------------------------------- input validation
+
+def test_answer_rejects_wrong_domain_key_with_bin_index():
+    """A key minted for the wrong table size must fail fast, naming the
+    offending BIN (the pre-PR path deserialized the whole group first
+    and reported only the size)."""
+    _, opt, sa, _, cl = _setup_lookup()
+    ka, _, _ = cl.make_queries([0])
+    bad = list(ka)
+    bad[1] = np.asarray(DPF(prf=DPF.PRF_DUMMY).gen(0, 512)[0])
+    with pytest.raises(ValueError, match=r"bin 1 .*got n=512"):
+        sa.answer(bad)
+    with pytest.raises(ValueError, match=r"bin 1"):
+        sa.answer_scalar(bad)
+
+
+def test_answer_rejects_wrong_construction_key():
+    """A radix-4 key sent to a binary group (and vice versa) is named by
+    bin, not mis-decoded."""
+    from dpf_tpu.utils.config import EvalConfig
+    _, opt, sa, _, cl = _setup_lookup()
+    ka, _, _ = cl.make_queries([0])
+    bad = list(ka)
+    d4 = DPF(config=EvalConfig(prf_method=DPF.PRF_DUMMY, radix=4))
+    bad[2] = np.asarray(d4.gen(0, sa.bin_sizes[2])[0])
+    with pytest.raises(ValueError, match=r"bin 2 .*radix marker 4"):
+        sa.answer(bad)
+
+    _, opt4, sa4, _, cl4 = _setup_lookup("logn", 4, DPF.PRF_CHACHA20)
+    ka4, _, _ = cl4.make_queries([0])
+    bad = list(ka4)
+    bad[0] = np.asarray(DPF(prf=DPF.PRF_CHACHA20).gen(
+        0, sa4.bin_sizes[0])[0])
+    with pytest.raises(ValueError, match=r"bin 0 .*radix marker 0"):
+        sa4.answer(bad)
+
+
+def test_answer_rejects_malformed_inputs():
+    _, opt, sa, _, cl = _setup_lookup()
+    ka, _, _ = cl.make_queries([0])
+    with pytest.raises(ValueError, match="expected one key per bin"):
+        sa.answer(ka[:-1])
+    with pytest.raises(ValueError, match="expected one key per bin"):
+        sa.answer_scalar(ka[:-1])
+    truncated = list(ka)
+    truncated[0] = np.asarray(truncated[0]).reshape(-1)[:100]
+    with pytest.raises(ValueError):
+        sa.answer(truncated)
+    # sqrt-N group: a different-domain key is a different wire LENGTH,
+    # rejected with the group context before any decode work
+    _, _, sq, _, cq = _setup_lookup("sqrtn")
+    kq, _, _ = cq.make_queries([0])
+    bad = list(kq)
+    bad[1] = np.asarray(DPF(prf=DPF.PRF_DUMMY, scheme="sqrtn").gen(
+        0, 512)[0])
+    with pytest.raises(ValueError, match=r"size-128 group"):
+        sq.answer(bad)
+    # ... and a same-length key with a corrupted domain header carries
+    # the bin index
+    bad = [np.asarray(k).copy() for k in kq]
+    bad[1].reshape(-1, 4).view(np.uint32)[2, 0] = 256
+    with pytest.raises(ValueError, match=r"bin 1 .*got n=256"):
+        sq.answer(bad)
+
+
+# -------------------------------------------------------- streaming
+
+def test_lookup_stream_matches_answer():
+    """Multi-round streaming through the per-group serving engines is
+    bit-identical to the blocking answer() on every round."""
+    table, opt, sa, sb, cl = _setup_lookup()
+    stream = sa.stream(max_in_flight=2, warmup=True)
+    rounds = []
+    futs = []
+    for r in range(4):
+        wanted = [sorted(b)[min(r, len(b) - 1)]
+                  for b in opt.hot_table_bins[:3]]
+        ka, kb, plan = cl.make_queries(wanted)
+        rounds.append((ka, kb, plan, wanted))
+        futs.append(stream.submit(ka))
+    stream.drain()
+    for (ka, kb, plan, wanted), fut in zip(rounds, futs):
+        assert fut.done()
+        ans = fut.result()
+        assert np.array_equal(ans, sa.answer(ka))
+        got = cl.recover(ans, sb.answer(kb), plan)
+        for w in wanted:
+            assert w in got and (got[w] == table[w]).all()
+    stats = stream.stats()
+    assert sum(s["batches_submitted"] for s in stats.values()) == 4 * len(
+        stats)
+    with pytest.raises(ValueError, match="expected one key per bin"):
+        stream.submit(rounds[0][0][:-1])
+
+
+# ------------------------------------------------------------- mesh
+
+def test_private_lookup_mesh_single_device():
+    """Mesh((1,)) smoke test (tier-1, runs on any host): the sharded
+    group/key plumbing (`_shard`/`_pad_keys`) must answer bit-identically
+    to the plain server and stream too."""
+    import jax
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("d",))
+    table, opt, plain, _, cl = _setup_lookup()
+    meshed = PrivateLookupServer(table, opt.hot_table_bins,
+                                 prf=DPF.PRF_DUMMY, mesh=mesh)
+    wanted = [sorted(b)[0] for b in opt.hot_table_bins[:3]]
+    ka, kb, plan = cl.make_queries(wanted)
+    assert np.array_equal(plain.answer(ka), meshed.answer(ka))
+    st = meshed.stream(warmup=True)
+    fut = st.submit(ka)
+    st.drain()
+    assert np.array_equal(fut.result(), plain.answer(ka))
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DPF_RUN_SLOW"),
+    reason="multi-device batch-PIR rehearsal (all constructions x "
+           "streaming over the 8-device CPU mesh) runs in the "
+           "DPF_RUN_SLOW lane; the Mesh((1,)) smoke and the 8-device "
+           "radix tests above pin the shard legs in tier-1")
+def test_private_lookup_mesh_streaming_rehearsal():
+    """Every construction answered over the full virtual mesh (group
+    pad to the device count exercised: 3 bins -> 8 shards), blocking
+    AND streaming, against the single-device server."""
+    import jax
+    from jax.sharding import Mesh
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    mesh = Mesh(np.asarray(jax.devices()[:8]).reshape(2, 4),
+                ("batch", "table"))
+    for scheme, radix, prf in (("logn", 2, DPF.PRF_DUMMY),
+                               ("logn", 4, DPF.PRF_CHACHA20),
+                               ("sqrtn", 2, DPF.PRF_CHACHA20)):
+        table, opt, plain, _, cl = _setup_lookup(scheme, radix, prf)
+        meshed = PrivateLookupServer(table, opt.hot_table_bins, prf=prf,
+                                     radix=radix, scheme=scheme,
+                                     mesh=mesh)
+        wanted = [sorted(b)[0] for b in opt.hot_table_bins[:3]]
+        ka, kb, plan = cl.make_queries(wanted)
+        want = plain.answer(ka)
+        assert np.array_equal(want, meshed.answer(ka)), (scheme, radix)
+        st = meshed.stream(warmup=True)
+        futs = [st.submit(ka) for _ in range(3)]
+        st.drain()
+        for f in futs:
+            assert np.array_equal(f.result(), want), (scheme, radix)
+
+
+def test_pir_config_rejects_unresolved_auto():
+    """The planner prices a concrete construction — 'auto' must fail at
+    config construction, not deep inside fetch(); the membership rule is
+    the serving stack's (sqrtn has no radix)."""
+    with pytest.raises(ValueError, match="must be one of"):
+        PIRConfig(scheme="auto")
+    with pytest.raises(ValueError):
+        PIRConfig(radix=3)
+    with pytest.raises(ValueError, match="has no radix"):
+        PIRConfig(scheme="sqrtn", radix=4)
+
+
+def test_sqrtn_group_rejects_short_keys_cleanly():
+    """Too-short sqrt-N wire keys fail the documented ValueError with
+    group context, not a raw IndexError from the header read."""
+    table = np.arange(300 * 4, dtype=np.int32).reshape(300, 4)
+    bins = [set(range(100))]
+    sa = PrivateLookupServer(table, bins, prf=DPF.PRF_DUMMY,
+                             scheme="sqrtn")
+    with pytest.raises(ValueError, match=r"size-128 group .*malformed"):
+        sa.answer([np.zeros(8, np.int32)])
+    with pytest.raises(ValueError, match=r"size-128 group"):
+        sa.answer([np.zeros(6, np.int32)])
+
+
+def test_lookup_stream_bad_round_leaves_no_orphan_dispatch():
+    """A bad key in a LATER size group must fail the whole round before
+    ANY group engine dispatches — no orphaned in-flight work, no
+    counter skew (unlike a per-group submit loop would)."""
+    table = np.arange(300 * 4, dtype=np.int32).reshape(300, 4)
+    bins = [set(range(100)), set(range(100, 280))]  # pads 128 and 256
+    sa = PrivateLookupServer(table, bins, prf=DPF.PRF_DUMMY)
+    cl = PrivateLookupClient(bins, sa.bin_sizes, prf=DPF.PRF_DUMMY)
+    assert len(sa._groups) == 2
+    stream = sa.stream(warmup=True)
+    ka, kb, plan = cl.make_queries([0, 150])
+    bad = list(ka)
+    bad[1] = np.asarray(DPF(prf=DPF.PRF_DUMMY).gen(0, 512)[0])
+    with pytest.raises(ValueError, match=r"bin 1 .*got n=512"):
+        stream.submit(bad)
+    assert all(s["batches_submitted"] == 0
+               for s in stream.stats().values())
+    fut = stream.submit(ka)
+    stream.drain()
+    assert np.array_equal(fut.result(), sa.answer(ka))

@@ -97,3 +97,63 @@ def test_keygen_validation():
         keygen.generate_keys(0, 100, b"x", 0)  # not a power of two
     with pytest.raises(ValueError):
         keygen.generate_keys(8, 8, b"x", 0)    # alpha out of range
+
+
+# ------------------------------------------------------- batched keygen
+
+@pytest.mark.parametrize("method", [0, 2, 3, 4])
+def test_gen_batched_matches_scalar(method):
+    """The vectorized generator is bit-identical to the scalar DRBG
+    construction — per key, both servers, every wire byte (the scalar
+    generator is the fuzz oracle)."""
+    rng = np.random.default_rng(method)
+    for n in (2, 8, 256, 4096):
+        bsz = 9
+        alphas = rng.integers(0, n, bsz)
+        seeds = [b"fz-%d-%d-%d" % (method, n, i) for i in range(bsz)]
+        wa, wb = keygen.gen_batched(alphas, n, seeds, prf_method=method)
+        assert wa.shape == wb.shape == (bsz, keygen.KEY_WORDS)
+        for i in range(bsz):
+            ka, kb = keygen.generate_keys(int(alphas[i]), n, seeds[i],
+                                          method)
+            assert np.array_equal(wa[i], ka.serialize()), (n, i)
+            assert np.array_equal(wb[i], kb.serialize()), (n, i)
+
+
+def test_gen_batched_decodes_and_recovers():
+    """Batched wire rows feed the batched codec directly and the shares
+    recover the point function."""
+    n, bsz = 128, 6
+    alphas = np.arange(bsz) * 7 % n
+    wa, wb = keygen.gen_batched(alphas, n, [b"d%d" % i for i in range(bsz)],
+                                prf_method=0)
+    pka = keygen.decode_keys_batched(wa)
+    pkb = keygen.decode_keys_batched(wb)
+    assert pka.n == n and pka.batch == bsz
+    for i in range(bsz):
+        fa = keygen.deserialize_key(wa[i])
+        fb = keygen.deserialize_key(wb[i])
+        for x in (0, int(alphas[i]), n - 1):
+            d = (keygen.evaluate_flat(fa, x, 0)
+                 - keygen.evaluate_flat(fb, x, 0)) & ((1 << 128) - 1)
+            assert d == (1 if x == alphas[i] else 0)
+
+
+def test_gen_batched_validation():
+    with pytest.raises(ValueError):
+        keygen.gen_batched([], 8, None, prf_method=0)       # empty batch
+    with pytest.raises(ValueError):
+        keygen.gen_batched([0], 100, None, prf_method=0)    # non-pow2 n
+    with pytest.raises(ValueError):
+        keygen.gen_batched([8], 8, None, prf_method=0)      # out of range
+    with pytest.raises(ValueError):
+        keygen.gen_batched([0, 1], 8, [b"one"], prf_method=0)  # seed count
+
+
+def test_gen_batched_rejects_non_list_seeds():
+    """A scalar bytes seed (the scalar-gen convention) must not zip
+    into per-byte zero-entropy DRBG seeds."""
+    with pytest.raises(TypeError, match="LIST of per-key"):
+        keygen.gen_batched([0, 1], 8, b"xy", prf_method=0)
+    with pytest.raises(TypeError, match="must be bytes"):
+        keygen.gen_batched([0, 1], 8, [b"ok", 7], prf_method=0)
