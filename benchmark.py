@@ -17,6 +17,15 @@
 # winning construction in the same tuning cache (committed record:
 # BENCH_SCHEME_r08.json).
 #
+# benchmark.py --multichip runs the mesh rehearsal matrix
+# (dpf_tpu/serve/bench_multichip.py): all three constructions x every
+# mesh split x shape through the mesh autotuner (dpf_tpu/tune/
+# mesh_tune.py) on a forced-8-device CPU mesh (utils/hermetic.py) —
+# tuned vs mesh-heuristic, every timed candidate equality-gated
+# (committed record: MULTICHIP_r06.json); --native uses the real
+# device mesh and produces the relay TPU record with the same
+# command.  See docs/SHARDING.md.
+#
 # benchmark.py --batch-pir runs the end-to-end batch-PIR benchmark
 # (dpf_tpu/serve/bench_pir.py): plan -> keygen -> answer -> recover on
 # the production path (batched keygen, packed group decode, tuned
@@ -85,6 +94,12 @@ def _autotune_scheme_main(argv):
 
 
 if __name__ == "__main__":
+    if "--multichip" in sys.argv:
+        # must run before anything touches a JAX backend: the bench
+        # forces the virtual CPU mesh first (utils/hermetic.py)
+        from dpf_tpu.serve.bench_multichip import main
+        main([a for a in sys.argv[1:] if a != "--multichip"])
+        sys.exit(0)
     if "--batch-pir" in sys.argv:
         from dpf_tpu.serve.bench_pir import main
         main([a for a in sys.argv[1:] if a != "--batch-pir"])

@@ -752,6 +752,30 @@ class DPF(object):
         from .serve import ServingEngine
         return ServingEngine(self, **kwargs)
 
+    # --------------------------------------------------------- mesh scale-out
+
+    def sharded_server(self, mesh=None, **kwargs):
+        """Mesh scale-out counterpart of ``serving_engine``: a
+        ``parallel.sharded.ShardedDPFServer`` over this DPF's table with
+        the same construction, PRF, and batch cap — the one-liner from a
+        single-device deployment to the mesh path (docs/SHARDING.md).
+
+        Requires a prior ``eval_init`` (which also resolves
+        ``scheme="auto"``, so the mesh server inherits the concrete
+        construction and keys already minted stay servable).  ``mesh``:
+        a ``parallel.sharded.make_mesh`` mesh (None = one over all
+        devices); kwargs forward to ``ShardedDPFServer`` (the explicit
+        knob pins ``chunk_leaves``/``row_chunk``/``psum_group``/
+        ``dot_impl``)."""
+        if self.table is None:
+            raise RuntimeError(
+                "Must call `eval_init` before `sharded_server`")
+        from .parallel.sharded import ShardedDPFServer
+        return ShardedDPFServer(
+            self.table, mesh, prf_method=self.prf_method,
+            batch_size=self.BATCH_SIZE, radix=self.radix,
+            scheme=self.scheme, **kwargs)
+
     # ------------------------------------------------------------ eval_free
 
     def eval_free(self, buffers=None):

@@ -465,10 +465,27 @@ class PrivateLookupServer:
         key = (n, batch, sch, rad)
         tuned = self._tuned.get(key)
         if tuned is None:
-            from ..tune.cache import lookup_eval_knobs
+            from ..tune.cache import lookup_eval_knobs, lookup_mesh_knobs
             tuned = lookup_eval_knobs(
                 n=n, entry_size=self.entry_size, batch=batch,
                 prf_method=self.prf_method, scheme=sch, radix=rad) or {}
+            if not tuned and self.mesh is not None:
+                # mesh-tagged fallback (benchmark.py --multichip
+                # populates it).  The single-device entry stays
+                # preferred: this group program evaluates FULL-range
+                # per-key tables with the bins sharded over the mesh,
+                # so its chunk range matches the single-device program
+                # family — a mesh entry's chunks were searched over a
+                # table-sharded program's PER-SHARD range and only
+                # approximate it; still measured knobs for this device,
+                # so better than frozen heuristics on a mesh-only-tuned
+                # machine (values re-clamped against the bin range
+                # below / at dispatch either way)
+                from ..tune.fingerprint import mesh_tag
+                tuned = lookup_mesh_knobs(
+                    n=n, entry_size=self.entry_size, batch=batch,
+                    prf_method=self.prf_method, scheme=sch, radix=rad,
+                    mesh=mesh_tag(self.mesh)) or {}
             self._tuned[key] = tuned
         if sch == "sqrtn":
             return {"dot_impl": tuned.get("dot_impl")

@@ -637,6 +637,132 @@ def eval_contract_per_key_tables(seeds, cw1, cw2, tables, *,
         row_chunk=row_chunk)
 
 
+# ----------------------------------------------------- mesh-sharded eval
+
+@functools.partial(jax.jit, static_argnames=("prf_method", "dot_impl",
+                                             "row_chunk", "psum_group",
+                                             "mesh"))
+def _eval_sharded_sqrt_jit(seeds, cw1, cw2, table, *, prf_method,
+                           dot_impl, row_chunk, psum_group, mesh):
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops import matmul128
+    from ..parallel.sharded import (_pvary, _scan_psum_groups,
+                                    _shard_map, _valid_psum_group)
+
+    n_shards = mesh.shape["table"]
+    k = seeds.shape[1]
+    r = cw1.shape[1]
+    e = table.shape[1]
+    r_local = r // n_shards
+    rc = row_chunk
+    steps = r_local // rc
+
+    def per_shard(seeds_l, cw1_l, cw2_l, tbl):
+        # seeds_l/cw*_l: this batch-shard's keys (codewords replicated
+        # over "table"); tbl: [r_local * K, E] — this chip's grid rows
+        bsz = seeds_l.shape[0]
+        shard_ix = jax.lax.axis_index("table")
+        row0_base = shard_ix.astype(jnp.uint32) * jnp.uint32(r_local)
+        c1 = jax.lax.dynamic_slice_in_dim(cw1_l, shard_ix * r_local,
+                                          r_local, axis=1)
+        c2 = jax.lax.dynamic_slice_in_dim(cw2_l, shard_ix * r_local,
+                                          r_local, axis=1)
+        sel = (seeds_l[:, None, :, 0] & np.uint32(1)).astype(bool)[..., None]
+
+        def contract(row0, c1_c, c2_c, tc):
+            """One [B, rc, K] grid chunk against its table rows."""
+            vals = _grid_vals(
+                prf_method,
+                lambda nr: jnp.broadcast_to(seeds_l[:, None, :, :],
+                                            (bsz, nr, k, 4)),
+                rc, jnp, row0=row0)                   # [B, rc, K, 4]
+            cw = jnp.where(sel, c2_c[:, :, None, :], c1_c[:, :, None, :])
+            leaves = u128.add128(vals, cw)[..., 0].astype(
+                jnp.int32).reshape(bsz, rc * k)
+            return matmul128.dot(leaves, tc, dot_impl)
+
+        tbl_chunks = tbl.reshape(steps, rc * k, e)
+        if steps == 1:
+            return jax.lax.psum(contract(row0_base, c1, c2,
+                                         tbl_chunks[0]), "table")
+        row0s = row0_base + jnp.arange(steps, dtype=jnp.uint32) \
+            * jnp.uint32(rc)
+        c1s = jnp.moveaxis(c1.reshape(bsz, steps, rc, 4), 1, 0)
+        c2s = jnp.moveaxis(c2.reshape(bsz, steps, rc, 4), 1, 0)
+
+        def body(acc, inp):
+            return acc + contract(*inp), None
+
+        zeros = jnp.zeros((bsz, e), jnp.int32)
+        g = _valid_psum_group(psum_group, steps)
+        if not g:  # one terminal psum after the local accumulation
+            acc, _ = jax.lax.scan(body, _pvary(zeros, ("batch", "table")),
+                                  (row0s, c1s, c2s, tbl_chunks))
+            return jax.lax.psum(acc, "table")
+        n_groups = steps // g
+        return _scan_psum_groups(body, zeros, (
+            row0s.reshape(n_groups, g),
+            c1s.reshape(n_groups, g, bsz, rc, 4),
+            c2s.reshape(n_groups, g, bsz, rc, 4),
+            tbl_chunks.reshape(n_groups, g, rc * k, e)), "table")
+
+    fn = _shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P("batch"), P("batch"), P("batch"), P("table", None)),
+        out_specs=P("batch", None))
+    return fn(seeds, cw1, cw2, table)
+
+
+def eval_sharded_sqrt(seeds, cw1, cw2, table, *, prf_method: int,
+                      mesh, dot_impl: str = "i32",
+                      row_chunk: int | None = None,
+                      psum_group: int | None = None):
+    """Mesh-parallel fused sqrt-N evaluation: the [R, K] grid row-sharded
+    over the "table" mesh axis, keys over "batch".
+
+    ``table`` is the NATURAL-order [N, E] int32 table sharded
+    ``P("table", None)`` (``parallel.sharded.shard_table_sqrt``) — grid
+    row ``r`` is table rows ``[r*K, (r+1)*K)``, so a contiguous
+    N/shards row block is exactly R/shards whole grid rows and the
+    sharding is key-split agnostic.  Each chip PRF-expands ONLY its own
+    grid rows in ``row_chunk``-row slabs (the per-shard counterpart of
+    ``eval_contract_batched``'s scan, same 64 MiB live-slab bound),
+    contracts locally, and partial [B, E] contractions are summed with
+    ``psum`` — int32 adds wrap, so the result is bit-identical to the
+    single-device oracle.
+
+    ``row_chunk`` rows are expanded per scan step PER SHARD (None = the
+    ``choose_row_chunk`` heuristic over R/shards); it must divide
+    R/shards and — when actually chunking — be a multiple of 4.
+    ``psum_group`` = scan steps accumulated locally between psums
+    (0/None = one terminal psum): smaller groups start collectives
+    earlier so ICI latency overlaps the next chunk's PRF expansion.
+    Returns [B, E] int32, sharded over "batch", replicated over "table".
+    """
+    bsz, k = seeds.shape[0], seeds.shape[1]
+    r = cw1.shape[1]
+    n_shards = mesh.shape["table"]
+    if r % n_shards:
+        raise ValueError(
+            "sqrt-N grid rows R=%d must divide over %d table shards"
+            % (r, n_shards))
+    r_local = r // n_shards
+    from .prf import _BLK_WORDS_V
+    if n_shards > 1 and prf_method in _BLK_WORDS_V \
+            and r_local % ROW_CHUNK_FLOOR:
+        raise ValueError(
+            "block-PRG sqrt-N sharding needs R/shards (%d) to be a "
+            "multiple of 4 (the 4-row core-block interleave must not "
+            "straddle a shard boundary) — use fewer table shards or a "
+            "wider n_keys split" % r_local)
+    row_chunk = _resolve_row_chunk(r_local, k, bsz, row_chunk)
+    return _eval_sharded_sqrt_jit(
+        jnp.asarray(seeds), jnp.asarray(cw1), jnp.asarray(cw2), table,
+        prf_method=prf_method, dot_impl=dot_impl, row_chunk=row_chunk,
+        psum_group=int(psum_group or 0), mesh=mesh)
+
+
 # ------------------------------------------------------ point evaluation
 
 def eval_points_sqrt_scalar(keys: list, indices, prf_method: int):

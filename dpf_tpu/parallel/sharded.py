@@ -18,6 +18,13 @@ Keys are ~2 KB each and broadcast over the mesh; output is [B, E] int32 —
 both negligible next to the O(N) expansion, so scaling is linear in chips
 until N/n_table_shards stops covering a chip.
 
+All three constructions run sharded (binary GGM here, radix-4 via the
+mixed engines, sqrt-N via ``core.sqrtn.eval_sharded_sqrt`` over a
+natural-order table), the psum can be issued per chunk-group
+(``psum_group`` — overlapping ICI latency with the next chunk's PRF
+expansion), and ``ShardedDPFServer`` resolves its knobs from the
+mesh-aware tuning cache (``tune/mesh_tune.py``).  See docs/SHARDING.md.
+
 Multi-host runs use the same code: construct the mesh from
 ``jax.distributed``-initialized global devices and lay the "table" axis on
 the ICI-adjacent dimension so psum rides ICI, not DCN.
@@ -70,16 +77,59 @@ def shard_table(table_i32: np.ndarray, mesh: Mesh):
     return jax.device_put(jnp.asarray(perm), sharding)
 
 
+def _valid_psum_group(psum_group, n_chunks: int) -> int:
+    """The effective chunk-group size for grouped psums: 0 (one terminal
+    psum) unless ``psum_group`` divides the chunk count with at least
+    two groups — a tuned value from another shape degrades to the
+    terminal psum rather than failing the program."""
+    g = int(psum_group or 0)
+    return g if 0 < g < n_chunks and n_chunks % g == 0 else 0
+
+
+def _scan_psum_groups(body, zeros, xs, axis_name: str):
+    """Grouped-psum driver shared by the three sharded constructions.
+
+    Scans ``xs`` (every leaf already reshaped to ``[n_groups, g, ...]``)
+    one chunk-group at a time: each group accumulates locally through
+    ``body`` (a standard per-chunk scan body), the group partial is
+    psummed over ``axis_name``, and the psum result adds onto the outer
+    carry — int32 wrap keeps any grouping exact, and the collective has
+    no data dependency on the NEXT group's PRF expansion, so an async
+    backend overlaps ICI latency with compute.
+
+    Carry typing: the INNER partial is varying over both mesh axes (its
+    body adds shard-local dot products), but the OUTER carry holds only
+    psum outputs — invariant along ``axis_name`` — so it is typed
+    varying over "batch" alone.  Typing it over both axes would trip
+    shard_map's out_specs invariance check on jaxlibs with varying
+    types (``lax.pvary`` present); on older jaxlibs both ``_pvary``
+    calls are identity.
+    """
+    def gbody(acc, xs_g):
+        part0 = _pvary(zeros, ("batch", axis_name))
+        part, _ = jax.lax.scan(body, part0, xs_g)
+        return acc + jax.lax.psum(part, axis_name), None
+
+    acc, _ = jax.lax.scan(gbody, _pvary(zeros, ("batch",)), xs)
+    return acc
+
+
 @functools.partial(jax.jit,
                    static_argnames=("depth", "prf_method", "chunk_leaves",
-                                    "mesh", "aes_impl"))
+                                    "mesh", "aes_impl", "psum_group"))
 def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
-                 chunk_leaves: int, mesh: Mesh, aes_impl: str | None = None):
+                 chunk_leaves: int, mesh: Mesh, aes_impl: str | None = None,
+                 psum_group: int = 0):
     """Mesh-parallel fused DPF evaluation.
 
     Inputs as in ``expand.expand_and_contract``; ``table_perm`` must be
-    row-sharded with ``shard_table``.  Returns [B, E] int32 shares,
-    replicated over the "table" axis and sharded over "batch".
+    row-sharded with ``shard_table``.  ``psum_group`` > 0 accumulates
+    the share psum per group of that many frontier-subtree chunks
+    instead of once at the end — each group's collective has no data
+    dependency on the next group's PRF expansion, so an async backend
+    overlaps ICI latency with compute (int32 adds wrap: grouping cannot
+    change the result).  Returns [B, E] int32 shares, replicated over
+    the "table" axis and sharded over "batch".
     """
     n_shards = mesh.shape["table"]
     n = table_perm.shape[0]
@@ -89,12 +139,13 @@ def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
     def per_shard(cw1, cw2, last, tbl_shard):
         # tbl_shard: [n/shards, E] — this chip's BFS leaf range
         shard_ix = jax.lax.axis_index("table")
-        out = _eval_leaf_range(cw1, cw2, last, tbl_shard,
-                               shard_ix * shard_rows,
-                               depth=depth, prf_method=prf_method,
-                               chunk_leaves=min(chunk_leaves, shard_rows),
-                               n_total=n, aes_impl=aes_impl)
-        return jax.lax.psum(out, "table")
+        out, psummed = _eval_leaf_range(
+            cw1, cw2, last, tbl_shard, shard_ix * shard_rows,
+            depth=depth, prf_method=prf_method,
+            chunk_leaves=min(chunk_leaves, shard_rows),
+            n_total=n, aes_impl=aes_impl, psum_group=psum_group,
+            axis_name="table")
+        return out if psummed else jax.lax.psum(out, "table")
 
     fn = _shard_map(
         per_shard, mesh=mesh,
@@ -105,13 +156,20 @@ def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
 
 def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
                      prf_method: int, chunk_leaves: int, n_total: int,
-                     aes_impl: str | None = None):
+                     aes_impl: str | None = None, psum_group: int = 0,
+                     axis_name: str | None = None):
     """Expand only BFS leaves [row0, row0 + tbl.rows) and contract locally.
 
     Phase 1 walks root -> this shard's frontier; because the shard is a
     contiguous BFS range, its frontier nodes are a contiguous range at the
     frontier level, reachable by expanding all of phase 1 (cheap: width F)
     and slicing the local window with a dynamic slice on the node axis.
+
+    Returns ``(out, psummed)``: with a valid ``psum_group`` (and an
+    ``axis_name`` to reduce over) the scan psums every chunk group and
+    ``out`` is already the mesh-wide sum (``psummed=True``); otherwise
+    ``out`` is this shard's local partial and the caller applies the
+    terminal psum.
     """
     rows = tbl.shape[0]
     e = tbl.shape[1]
@@ -138,7 +196,8 @@ def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
 
     tbl_chunks = tbl.reshape(f_local, c, e)
     if f_local == 1:
-        return expand._dot_i32(expand_subtree(seeds[:, 0, :]), tbl_chunks[0])
+        return (expand._dot_i32(expand_subtree(seeds[:, 0, :]),
+                                tbl_chunks[0]), False)
 
     frontier = jnp.moveaxis(seeds, 1, 0)  # [f_local, B, 4]
 
@@ -146,12 +205,17 @@ def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
         node_seeds, chunk = xs
         return acc + expand._dot_i32(expand_subtree(node_seeds), chunk), None
 
-    acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
-    # inside shard_map the scan carry must be typed as varying over the
-    # mesh axes (the body's output is), or the carry types mismatch
-    acc0 = _pvary(acc0, ("batch", "table"))
-    acc, _ = jax.lax.scan(body, acc0, (frontier, tbl_chunks))
-    return acc
+    zeros = jnp.zeros((bsz, e), dtype=jnp.int32)
+    g = _valid_psum_group(psum_group, f_local) if axis_name else 0
+    if not g:
+        # inside shard_map the scan carry must be typed as varying over
+        # the mesh axes (the body's output is), or the carry mismatches
+        acc, _ = jax.lax.scan(body, _pvary(zeros, ("batch", "table")),
+                              (frontier, tbl_chunks))
+        return acc, False
+    return _scan_psum_groups(body, zeros, (
+        frontier.reshape(f_local // g, g, bsz, 4),
+        tbl_chunks.reshape(f_local // g, g, c, e)), axis_name), True
 
 
 def shard_table_mixed(table_i32: np.ndarray, mesh: Mesh):
@@ -164,15 +228,26 @@ def shard_table_mixed(table_i32: np.ndarray, mesh: Mesh):
                           sharding)
 
 
+def shard_table_sqrt(table_i32: np.ndarray, mesh: Mesh):
+    """Row-shard a NATURAL-order table over the "table" axis for the
+    sqrt-N construction (the grid emits natural order — no permutation):
+    a contiguous N/shards row block is exactly R/shards whole grid rows
+    for any key split whose R divides over the shards."""
+    sharding = NamedSharding(mesh, P("table", None))
+    return jax.device_put(
+        jnp.asarray(np.asarray(table_i32, dtype=np.int32)), sharding)
+
+
 @functools.partial(jax.jit,
                    static_argnames=("n", "prf_method", "chunk_leaves",
-                                    "mesh", "aes_impl"))
+                                    "mesh", "aes_impl", "psum_group"))
 def eval_sharded_mixed(cw1, cw2, last, table_perm, *, n: int,
                        prf_method: int, chunk_leaves: int, mesh: Mesh,
-                       aes_impl: str | None = None):
+                       aes_impl: str | None = None, psum_group: int = 0):
     """Mesh-parallel radix-4 evaluation (the mixed-radix counterpart of
     ``eval_sharded``): each chip owns whole trailing radix-4 subtrees of
-    the digit-reversed table, expands only those, psums partials."""
+    the digit-reversed table, expands only those, psums partials —
+    per ``psum_group`` chunks when set, terminally otherwise."""
     from ..core import radix4
     ars = radix4.arities(n)
     offs = radix4.cw_offsets(ars)
@@ -210,18 +285,24 @@ def eval_sharded_mixed(cw1, cw2, last, table_perm, *, n: int,
         if f_local == 1:
             out = expand._dot_i32(expand_subtree(seeds[:, 0, :]),
                                   tbl_chunks[0])
-        else:
-            frontier = jnp.moveaxis(seeds, 1, 0)
+            return jax.lax.psum(out, "table")
 
-            def body(acc, xs):
-                node_seeds, chunk = xs
-                return acc + expand._dot_i32(expand_subtree(node_seeds),
-                                             chunk), None
+        frontier = jnp.moveaxis(seeds, 1, 0)
 
-            acc0 = jnp.zeros((bsz, e), dtype=jnp.int32)
-            acc0 = _pvary(acc0, ("batch", "table"))
-            out, _ = jax.lax.scan(body, acc0, (frontier, tbl_chunks))
-        return jax.lax.psum(out, "table")
+        def body(acc, xs):
+            node_seeds, chunk = xs
+            return acc + expand._dot_i32(expand_subtree(node_seeds),
+                                         chunk), None
+
+        zeros = jnp.zeros((bsz, e), dtype=jnp.int32)
+        g = _valid_psum_group(psum_group, f_local)
+        if not g:
+            out, _ = jax.lax.scan(body, _pvary(zeros, ("batch", "table")),
+                                  (frontier, tbl_chunks))
+            return jax.lax.psum(out, "table")
+        return _scan_psum_groups(body, zeros, (
+            frontier.reshape(f_local // g, g, bsz, 4),
+            tbl_chunks.reshape(f_local // g, g, c, e)), "table")
 
     fn = _shard_map(
         per_shard, mesh=mesh,
@@ -233,44 +314,102 @@ def eval_sharded_mixed(cw1, cw2, last, table_perm, *, n: int,
 class ShardedDPFServer:
     """Convenience server wrapper: one table, mesh-parallel evaluation.
 
-    The multi-chip counterpart of ``DPF.eval_init``/``eval_tpu``.
+    The multi-chip counterpart of ``DPF.eval_init``/``eval_tpu``, for
+    all three constructions: ``scheme="logn"`` (binary GGM, or the
+    radix-4 tree with ``radix=4``), ``scheme="sqrtn"`` (natural-order
+    table, ``sqrtn.eval_sharded_sqrt``), or ``scheme="auto"`` — the
+    measured per-shape winner from the scheme tuning cache, resolved at
+    construction exactly like ``DPF(scheme="auto")``
+    (``scheme_resolved_from`` says which path answered).
+
+    Knob resolution (``resolved_eval_knobs``) follows the DPF
+    precedence per knob: an EXPLICIT value (ctor argument, or the
+    matching attribute assigned afterwards) wins; auto (None) fields
+    take the MESH-tuned entry for this device x mesh split
+    (``tune.cache.lookup_mesh_knobs``, populated by ``benchmark.py
+    --multichip``), then the single-device tuned entry, then the static
+    per-shard heuristic (chunk choices clamp against the SHARD row
+    count, not the full table — a tuned single-device chunk must not
+    exceed a shard's leaf range).
     """
 
     def __init__(self, table, mesh: Mesh | None = None, prf_method: int = 3,
-                 batch_size: int = 512, radix: int = 2):
+                 batch_size: int = 512, radix: int = 2,
+                 scheme: str = "logn", chunk_leaves: int | None = None,
+                 row_chunk: int | None = None,
+                 psum_group: int | None = None,
+                 dot_impl: str | None = None):
         from ..core import keygen  # local import to avoid cycles
+        from ..utils.config import check_construction
         self._keygen = keygen
         self.mesh = mesh if mesh is not None else make_mesh()
         tbl = np.asarray(table, dtype=np.int32)
         self.n, self.entry_size = tbl.shape
         assert self.n & (self.n - 1) == 0
-        assert radix in (2, 4)
+        check_construction(scheme, radix)
+        self.scheme_resolved_from = None
+        if scheme == "auto":
+            if radix == 4:
+                raise ValueError(
+                    "scheme='auto' resolves the whole construction "
+                    "(scheme AND radix) from the tuning cache; leave "
+                    "radix at 2")
+            scheme, radix = self._resolve_auto_scheme(batch_size,
+                                                     prf_method)
+        self.scheme = scheme
         self.radix = radix
         self.depth = self.n.bit_length() - 1
         self.prf_method = prf_method
         self.batch_size = batch_size
-        if radix == 4:
+        n_shards = self.mesh.shape["table"]
+        if self.n % n_shards:
+            raise ValueError(
+                "table rows (%d) must divide over %d table shards"
+                % (self.n, n_shards))
+        if self.scheme == "sqrtn":
+            self.table_sharded = shard_table_sqrt(tbl, self.mesh)
+        elif self.radix == 4:
             self.table_sharded = shard_table_mixed(tbl, self.mesh)
         else:
             self.table_sharded = shard_table(tbl, self.mesh)
-        shard_rows = self.n // self.mesh.shape["table"]
-        # tuned chunk_leaves (persistent tuning cache, keyed by device
-        # fingerprint x shape) when one exists for this shape, else the
-        # static heuristic — capped at the shard height either way
-        from ..tune.cache import lookup_eval_knobs
-        tuned = lookup_eval_knobs(
-            n=self.n, entry_size=self.entry_size, batch=batch_size,
-            prf_method=prf_method, scheme="logn", radix=radix) or {}
-        self.chunk = min(expand.clamp_chunk(tuned.get("chunk_leaves"),
-                                            self.n, batch_size),
-                         shard_rows)
+        # the explicit knob layer: ctor args (None = auto); assigning
+        # these attributes afterwards pins the knob the same way
+        self.chunk = chunk_leaves
+        self.row_chunk = row_chunk
+        self.psum_group = psum_group
+        self.dot_impl = dot_impl
+        self._tuned_memo = {}  # batch -> (mesh-tuned, single-tuned) dicts
+
+    def _resolve_auto_scheme(self, batch_size: int, prf_method: int):
+        """scheme="auto" -> the concrete construction, the DPF way:
+        scheme tuning cache first (the ``benchmark.py --autotune-scheme``
+        winner for this shape on this machine), else the conservative
+        cold-cache heuristic."""
+        from ..tune.cache import lookup_scheme
+        rec = lookup_scheme(n=self.n, entry_size=self.entry_size,
+                            batch=batch_size, prf_method=prf_method)
+        if rec and rec.get("scheme") in ("logn", "sqrtn"):
+            self.scheme_resolved_from = "cache"
+        else:
+            from ..tune.search import heuristic_scheme
+            rec = heuristic_scheme(self.n)
+            self.scheme_resolved_from = "heuristic"
+        return rec["scheme"], int(rec.get("radix") or 2)
+
+    @property
+    def shard_rows(self) -> int:
+        """Table rows each "table"-axis shard owns."""
+        return self.n // self.mesh.shape["table"]
 
     def _decode_batch(self, keys):
-        """Vectorized ingest: wire keys -> PackedKeys validated against
-        this server's table (shared with the serving engine)."""
+        """Vectorized ingest: wire keys -> packed batch validated
+        against this server's table (shared with the serving engine)."""
         if not len(keys):
             raise ValueError("empty key batch")
-        if self.radix == 4:
+        if self.scheme == "sqrtn":
+            from ..core import sqrtn
+            pk = sqrtn.decode_sqrt_keys_batched(keys)
+        elif self.radix == 4:
             from ..core import radix4
             pk = radix4.decode_mixed_keys_batched(keys)
         else:
@@ -280,6 +419,72 @@ class ShardedDPFServer:
                              % (pk.n, self.n))
         return pk
 
+    def resolved_eval_knobs(self, batch: int) -> dict:
+        """Concrete mesh-program knobs for one dispatch batch size:
+        explicit attribute > mesh-tuned (this device x mesh split,
+        ``lookup_mesh_knobs``) > single-device tuned > heuristic.
+        Chunk knobs resolve against the PER-SHARD row count (the shard
+        owns ``shard_rows`` leaves / R/shards grid rows, not N).
+
+        scheme='sqrtn': ``row_chunk`` may come back None — the dispatch
+        resolves it against the decoded batch's key split
+        (``sqrtn.clamp_row_chunk``), which only the keys know."""
+        from ..ops import matmul128
+        from ..tune.cache import lookup_eval_knobs, lookup_mesh_knobs
+        from ..tune.fingerprint import mesh_tag
+        explicit = {"chunk_leaves": self.chunk,
+                    "row_chunk": self.row_chunk,
+                    "psum_group": self.psum_group,
+                    "dot_impl": self.dot_impl}
+        fields = (("row_chunk", "psum_group", "dot_impl")
+                  if self.scheme == "sqrtn"
+                  else ("chunk_leaves", "psum_group", "dot_impl"))
+        if all(explicit[f] is not None for f in fields):
+            # fully pinned (the mesh tuner measuring a candidate): no
+            # cache reads — a stale entry must not leak into the knobs
+            tuned = single = {}
+        else:
+            # the cache lookups are memoized per batch (this is the
+            # serving hot path); the process-global fallbacks below are
+            # re-read every call so set_dot_impl stays live, matching
+            # DPF.resolved_eval_knobs
+            memo = self._tuned_memo.get(batch)
+            if memo is None:
+                memo = (lookup_mesh_knobs(
+                            n=self.n, entry_size=self.entry_size,
+                            batch=batch, prf_method=self.prf_method,
+                            scheme=self.scheme, radix=self.radix,
+                            mesh=mesh_tag(self.mesh)) or {},
+                        lookup_eval_knobs(
+                            n=self.n, entry_size=self.entry_size,
+                            batch=batch, prf_method=self.prf_method,
+                            scheme=self.scheme, radix=self.radix) or {})
+                self._tuned_memo[batch] = memo
+            tuned, single = memo
+
+        def pick(field, fallback=None):
+            if explicit[field] is not None:
+                return explicit[field]
+            v = tuned.get(field, single.get(field))
+            return v if v is not None else fallback
+
+        out = {"psum_group": int(pick("psum_group", 0) or 0),
+               "dot_impl": pick("dot_impl", matmul128.default_impl())}
+        if self.scheme == "sqrtn":
+            out["row_chunk"] = pick("row_chunk")
+            return out
+        if explicit["chunk_leaves"] is not None:
+            out["chunk_leaves"] = min(int(explicit["chunk_leaves"]),
+                                      self.shard_rows)
+        else:
+            # clamp against the shard's own leaf range: tuned entries
+            # (mesh or single-device) key on the table shape, and a
+            # single-device chunk can exceed what one shard holds
+            out["chunk_leaves"] = expand.clamp_chunk(
+                tuned.get("chunk_leaves", single.get("chunk_leaves")),
+                self.shard_rows, batch)
+        return out
+
     def _dispatch_packed(self, pk):
         """Pad to the mesh "batch" axis and dispatch WITHOUT a host sync
         (async, for the serving engine's host/device overlap).  The
@@ -288,26 +493,44 @@ class ShardedDPFServer:
         from ..core import prf as _prf
         pk = pk.pad_to(pk.batch
                        + (-pk.batch) % max(self.mesh.shape["batch"], 1))
+        kn = self.resolved_eval_knobs(pk.batch)
+        if self.scheme == "sqrtn":
+            from ..core import sqrtn
+            n_shards = self.mesh.shape["table"]
+            if pk.n_codewords % n_shards:
+                raise ValueError(
+                    "sqrt-N key split R=%d does not divide over %d "
+                    "table shards" % (pk.n_codewords, n_shards))
+            rc = kn["row_chunk"]
+            if self.row_chunk is None:
+                # harden a tuned/absent row_chunk against THIS batch's
+                # key split; an explicit pin passes through so an
+                # invalid value raises instead of silently measuring
+                # the heuristic (the DPF dispatch rule)
+                rc = sqrtn.clamp_row_chunk(
+                    rc, pk.n_codewords // n_shards, pk.n_keys, pk.batch)
+            return sqrtn.eval_sharded_sqrt(
+                pk.seeds, pk.cw1, pk.cw2, self.table_sharded,
+                prf_method=self.prf_method, mesh=self.mesh,
+                dot_impl=kn["dot_impl"], row_chunk=rc,
+                psum_group=kn["psum_group"])
         if self.radix == 4:
             return eval_sharded_mixed(
                 pk.cw1, pk.cw2, pk.last, self.table_sharded, n=self.n,
-                prf_method=self.prf_method, chunk_leaves=self.chunk,
-                mesh=self.mesh, aes_impl=_prf._aes_pair_impl())
+                prf_method=self.prf_method,
+                chunk_leaves=kn["chunk_leaves"], mesh=self.mesh,
+                aes_impl=_prf._aes_pair_impl(),
+                psum_group=kn["psum_group"])
         return eval_sharded(pk.cw1, pk.cw2, pk.last, self.table_sharded,
                             depth=self.depth, prf_method=self.prf_method,
-                            chunk_leaves=self.chunk, mesh=self.mesh,
-                            aes_impl=_prf._aes_pair_impl())
+                            chunk_leaves=kn["chunk_leaves"],
+                            mesh=self.mesh,
+                            aes_impl=_prf._aes_pair_impl(),
+                            psum_group=kn["psum_group"])
 
     def eval(self, keys) -> np.ndarray:
         pk = self._decode_batch(keys)
         return np.asarray(self._dispatch_packed(pk))[:pk.batch]
-
-    def resolved_eval_knobs(self, batch: int) -> dict:
-        """The mesh path's effective program knobs (for benchmark
-        records — serve/engine.py ``resolved_config``)."""
-        from ..ops import matmul128
-        return {"chunk_leaves": self.chunk,
-                "dot_impl": matmul128.default_impl()}
 
     def serving_engine(self, **kwargs):
         """Mesh-path ``ServingEngine`` (serve/engine.py) over this server."""

@@ -11,15 +11,23 @@ oracle, and the winners persisted in a JSON cache keyed by device
 fingerprint x shape (``cache``/``fingerprint``).  ``search.scheme_sweep``
 goes one level up and races the three constructions (logn, radix-4,
 sqrtn) per shape, so the cache can also answer "which construction"
-(``cache.lookup_scheme``).  ``compcache`` wires JAX's persistent
-compilation cache alongside, so tuned programs also skip the XLA
-recompile across processes.  See docs/TUNING.md.
+(``cache.lookup_scheme``).  ``mesh_tune`` extends the space to the
+mesh path — per-shard chunking, psum granularity, the mesh-shape split,
+and the engine ladder on the mesh batch axis — keyed by device
+fingerprint x mesh split (``benchmark.py --multichip`` drives it; see
+docs/SHARDING.md).  ``compcache`` wires JAX's persistent compilation
+cache alongside, so tuned programs also skip the XLA recompile across
+processes.  See docs/TUNING.md.
 """
 
 from .cache import (  # noqa: F401
-    TuningCache, default_cache, lookup_eval_knobs, lookup_scheme)
+    TuningCache, default_cache, lookup_eval_knobs, lookup_mesh_knobs,
+    lookup_scheme)
 from .compcache import enable as enable_compilation_cache  # noqa: F401
-from .fingerprint import cache_key, device_fingerprint  # noqa: F401
+from .fingerprint import cache_key, device_fingerprint, mesh_tag  # noqa: F401
+from .mesh_tune import (  # noqa: F401
+    lookup_mesh_split, mesh_split_candidates, tune_mesh_eval,
+    tune_mesh_serving, tune_mesh_shape)
 from .search import (  # noqa: F401
     autotune_sweep, heuristic_knobs, scheme_sweep, stage_candidates,
     tune_eval)

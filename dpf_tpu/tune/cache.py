@@ -187,6 +187,23 @@ def lookup_eval_knobs(*, n: int, entry_size: int, batch: int,
         return None
 
 
+def lookup_mesh_knobs(*, n: int, entry_size: int, batch: int,
+                      prf_method: int, mesh: str, scheme: str = "logn",
+                      radix: int = 2) -> dict | None:
+    """Tuned MESH-path knobs (per-shard chunk_leaves/row_chunk, psum
+    granularity) for this shape on this machine AND this mesh split
+    (``mesh`` = ``fingerprint.mesh_tag``, e.g. "2x4"); populated by
+    ``benchmark.py --multichip`` (``tune.mesh_tune``).  Nearest-batch
+    fallback like the single-device lookup.  Never raises."""
+    try:
+        return default_cache().lookup_knobs(
+            "mesh", nearest_batch=True, n=n, entry_size=entry_size,
+            batch=batch, prf_method=prf_method, scheme=scheme,
+            radix=radix, mesh=mesh)
+    except Exception:  # pragma: no cover — cache must never break serving
+        return None
+
+
 def lookup_scheme(*, n: int, entry_size: int, batch: int,
                   prf_method: int) -> dict | None:
     """The measured winning construction for this shape on this machine

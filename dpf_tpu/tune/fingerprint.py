@@ -39,16 +39,38 @@ def device_fingerprint() -> str:
 
 
 def shape_key(*, n: int, entry_size: int, batch: int, prf_method: int,
-              scheme: str = "logn", radix: int = 2) -> str:
-    return "n%d.e%d.b%d.prf%d.%s.r%d" % (
+              scheme: str = "logn", radix: int = 2,
+              mesh: str | None = None) -> str:
+    """``mesh``: the mesh-shape tag (``mesh_tag``, e.g. "2x4" for a
+    2-batch x 4-table mesh) for the mesh-path kinds ("mesh", "mserve",
+    "meshsplit") — a knob set tuned for one split is meaningless on
+    another, so the shape half of the key carries it.  None (the
+    single-device kinds) keeps the pre-mesh key grammar byte-identical,
+    so existing cache files stay valid."""
+    key = "n%d.e%d.b%d.prf%d.%s.r%d" % (
         n, entry_size, batch, prf_method, scheme, radix)
+    if mesh is not None:
+        key += ".m%s" % mesh
+    return key
+
+
+def mesh_tag(mesh) -> str:
+    """The mesh-shape half of a mesh-path cache key:
+    ``<n_batch>x<n_table>`` for a ``parallel.sharded.make_mesh`` mesh;
+    any other axis layout (e.g. a custom batch-PIR group mesh) tags as
+    ``<axis><size>`` pairs in axis order."""
+    shape = dict(mesh.shape)
+    if set(shape) == {"batch", "table"}:
+        return "%dx%d" % (shape["batch"], shape["table"])
+    return "x".join("%s%d" % (a, shape[a]) for a in mesh.axis_names)
 
 
 def cache_key(kind: str, *, n: int, entry_size: int, batch: int,
               prf_method: int, scheme: str = "logn", radix: int = 2,
+              mesh: str | None = None,
               fingerprint: str | None = None) -> str:
     """Full tuning-cache key: ``<kind>|<device>|<shape>``."""
     fp = fingerprint if fingerprint is not None else device_fingerprint()
     return "%s|%s|%s" % (kind, fp, shape_key(
         n=n, entry_size=entry_size, batch=batch, prf_method=prf_method,
-        scheme=scheme, radix=radix))
+        scheme=scheme, radix=radix, mesh=mesh))

@@ -48,16 +48,26 @@ def synthetic_trace(cap: int, batches: int = 16, seed: int = 7) -> list:
 
 def serve_shape_of(server) -> dict:
     """The cache-key shape fields of a prepared server (api.DPF or
-    ShardedDPFServer)."""
+    ShardedDPFServer).  A mesh server's shape carries its mesh split
+    (``fingerprint.mesh_tag``): the batch axis changes which ladders
+    even make sense, so mesh serving knobs must not be confused with
+    single-device ones (``mesh_tune.tune_mesh_serving`` populates the
+    mesh-tagged entries, ``lookup_serve_knobs`` reads them back
+    transparently through this shape)."""
     n = getattr(server, "table_num_entries", None) or server.n
     e = (getattr(server, "table_effective_entry_size", None)
          or getattr(server, "entry_size"))
-    return {
+    shape = {
         "n": int(n), "entry_size": int(e),
         "prf_method": server.prf_method,
         "scheme": getattr(server, "scheme", "logn"),
         "radix": getattr(server, "radix", 2),
     }
+    mesh = getattr(server, "mesh", None)
+    if mesh is not None:
+        from .fingerprint import mesh_tag
+        shape["mesh"] = mesh_tag(mesh)
+    return shape
 
 
 def lookup_serve_knobs(server, cap: int,

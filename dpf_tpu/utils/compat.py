@@ -1,0 +1,46 @@
+"""Capability probes for jax/jaxlib features the repo degrades around.
+
+The pinned container toolchain (jax/jaxlib 0.4.37) predates several
+features the test suite and the mesh path lean on; each probe here
+answers "can THIS process do X" so callers (tests, mostly) can skip
+cleanly instead of failing on a known toolchain gap.  Everything is a
+cheap attribute/version check — no backend initialization, so the
+probes are safe to call before ``hermetic.force_cpu_mesh``.
+"""
+
+from __future__ import annotations
+
+
+def jax_version() -> tuple:
+    """jax's version as an int tuple (best effort: non-int parts drop)."""
+    import jax
+    out = []
+    for part in jax.__version__.split("."):
+        digits = "".join(c for c in part if c.isdigit())
+        if not digits:
+            break
+        out.append(int(digits))
+    return tuple(out)
+
+
+def has_tpu_interpret_mode() -> bool:
+    """True when Pallas ships the TPU-semantics interpreter
+    (``pltpu.force_tpu_interpret_mode``, jax >= 0.4.38).  Without it the
+    interpret-mode kernel tests cannot run on this host: the generic
+    ``interpret=True`` engine compiles interpreted grids with XLA-CPU
+    and blows up super-linearly (tests/test_pallas_level.py docstring).
+    """
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except Exception:  # pragma: no cover - pallas not shipped at all
+        return False
+    return hasattr(pltpu, "force_tpu_interpret_mode")
+
+
+def has_cpu_multiprocess() -> bool:
+    """True when the CPU backend supports multi-process computations
+    (cross-process collectives).  jaxlib 0.4.x's CPU client raises
+    ``INVALID_ARGUMENT: Multiprocess computations aren't implemented on
+    the CPU backend`` from the first sharded ``device_put``; the
+    capability landed in the 0.5 line."""
+    return jax_version() >= (0, 5)

@@ -1,0 +1,201 @@
+"""Mesh-aware autotuner tests (tune/mesh_tune.py) on the virtual
+8-device CPU mesh: cache-key grammar, the staged search with its
+equality gate, and knob consumption by the mesh server + engine."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.tune.fingerprint import cache_key, mesh_tag, shape_key
+
+
+@pytest.fixture(scope="module")
+def eight_devices():
+    import jax
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 (virtual) devices")
+    return jax.devices()
+
+
+@pytest.fixture()
+def tmp_cache(monkeypatch, tmp_path):
+    path = str(tmp_path / "tuning.json")
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", path)
+    from dpf_tpu.tune.cache import default_cache
+    default_cache(refresh=True)
+    return path
+
+
+def test_mesh_cache_key_grammar():
+    """The mesh field extends the shape half without touching the
+    pre-mesh grammar (existing cache files must stay valid)."""
+    base = shape_key(n=1024, entry_size=16, batch=8, prf_method=0)
+    assert base == "n1024.e16.b8.prf0.logn.r2"
+    assert shape_key(n=1024, entry_size=16, batch=8, prf_method=0,
+                     mesh="2x4") == base + ".m2x4"
+    k = cache_key("mesh", n=1024, entry_size=16, batch=8, prf_method=0,
+                  mesh="2x4", fingerprint="fp")
+    assert k == "mesh|fp|" + base + ".m2x4"
+
+
+def test_mesh_tag(eight_devices):
+    from dpf_tpu.parallel.sharded import make_mesh
+    assert mesh_tag(make_mesh(n_table=4, n_batch=2)) == "2x4"
+    assert mesh_tag(make_mesh(n_table=1, n_batch=8)) == "8x1"
+
+
+def test_mesh_split_candidates():
+    from dpf_tpu.tune.mesh_tune import mesh_split_candidates
+    assert mesh_split_candidates(8) == [(1, 8), (2, 4), (4, 2), (8, 1)]
+    assert mesh_split_candidates(1) == [(1, 1)]
+
+
+def test_mesh_stage_candidates_per_shard():
+    """Chunk candidates span the PER-SHARD row range; psum-group
+    candidates are divisors of the current chunk count with the
+    terminal psum always a member."""
+    from dpf_tpu.tune.mesh_tune import mesh_stage_candidates
+    cands = mesh_stage_candidates("chunk_leaves", {}, n=2048, batch=8,
+                                  n_table=8)
+    assert all(256 % c == 0 or c <= 256 for c in cands)
+    assert all(c <= 256 for c in cands)  # never above shard_rows
+    pg = mesh_stage_candidates("psum_group", {"chunk_leaves": 32},
+                               n=2048, batch=8, n_table=8)
+    assert pg[0] == 0 and all(8 % g == 0 for g in pg[1:])
+    # single-step programs have nothing to group
+    assert mesh_stage_candidates("psum_group", {"chunk_leaves": 256},
+                                 n=2048, batch=8, n_table=8) == [0]
+
+
+def test_tune_mesh_eval_search_and_consume(eight_devices, tmp_cache):
+    """End to end: cold-cache search (equality-gated, tuned <=
+    heuristic), warm-cache answer, and ShardedDPFServer picking the
+    tuned knobs up through resolved_eval_knobs."""
+    from dpf_tpu.parallel.sharded import ShardedDPFServer, make_mesh
+    from dpf_tpu.tune.cache import lookup_mesh_knobs
+    from dpf_tpu.tune.mesh_tune import tune_mesh_eval
+    mesh = make_mesh(n_table=4, n_batch=2)
+    rec = tune_mesh_eval(512, 4, mesh=mesh, prf_method=0, reps=1,
+                         distinct=4)
+    assert rec["searched"] and rec["gated"]
+    m = rec["measured"]
+    assert m["rejected"] == 0
+    assert m["best_s"] <= m["heuristic_s"]
+    assert m["mesh"] == "2x4"
+
+    rec2 = tune_mesh_eval(512, 4, mesh=mesh, prf_method=0, reps=1,
+                          distinct=4)
+    assert not rec2["searched"]  # warm cache: nothing ran
+
+    knobs = lookup_mesh_knobs(n=512, entry_size=16, batch=4,
+                              prf_method=0, mesh="2x4")
+    assert knobs == rec["knobs"]
+    table = np.zeros((512, 16), np.int32)
+    srv = ShardedDPFServer(table, mesh, prf_method=0, batch_size=4)
+    kn = srv.resolved_eval_knobs(4)
+    assert kn["chunk_leaves"] == knobs["chunk_leaves"]
+    assert kn["psum_group"] == knobs["psum_group"]
+
+
+def test_tune_mesh_serving_engine_consumes(eight_devices, tmp_cache):
+    """The mesh serving tuner persists under the serve kind WITH the
+    mesh tag, and warmup(tune=True) on an engine over the SAME mesh
+    server shape reads it back; a single-device engine does not."""
+    import dpf_tpu
+    from dpf_tpu.parallel.sharded import ShardedDPFServer, make_mesh
+    from dpf_tpu.tune.mesh_tune import tune_mesh_serving
+    from dpf_tpu.tune.serve_tune import lookup_serve_knobs, serve_shape_of
+    mesh = make_mesh(n_table=4, n_batch=2)
+    table = np.random.default_rng(0).integers(
+        0, 2 ** 31, (512, 16), dtype=np.int64).astype(np.int32)
+    dpf = dpf_tpu.DPF(prf=0)
+    srv = ShardedDPFServer(table, mesh, prf_method=0, batch_size=4)
+    rec = tune_mesh_serving(srv, dpf, cap=4, reps=1, distinct=4,
+                            in_flight=(1,), ladders=[(4,), (2, 4)])
+    assert rec["searched"] and rec["gated"]
+    assert rec["measured"]["mesh"] == "2x4"
+    assert serve_shape_of(srv)["mesh"] == "2x4"
+
+    assert lookup_serve_knobs(srv, 4) == rec["knobs"]
+    eng = srv.serving_engine()
+    eng.warmup(tune=True)
+    assert list(eng.buckets.sizes) == rec["knobs"]["buckets"]
+    assert eng.max_in_flight == rec["knobs"]["max_in_flight"]
+
+    # the single-device shape has no mesh field -> different key space
+    dpf.eval_init(table)
+    assert "mesh" not in serve_shape_of(dpf)
+    assert lookup_serve_knobs(dpf, 4) is None
+
+
+def test_batch_pir_group_knobs_consult_mesh_cache(
+        eight_devices, tmp_cache, monkeypatch):
+    """A sharded PrivateLookupServer prefers the single-device entry
+    (its per-key-tables program evaluates FULL bin ranges — the same
+    chunk range as the single-device program family) and falls back to
+    the mesh-tagged entry on a mesh-only-tuned machine; an unsharded
+    server never reads the mesh entries."""
+    from dpf_tpu.apps.batch_pir import PrivateLookupServer
+    from dpf_tpu.parallel.sharded import make_mesh
+    from dpf_tpu.tune.cache import TuningCache, default_cache
+    mesh = make_mesh(n_table=4, n_batch=2)
+    n_bin = 128  # bins pad to the 128-entry floor
+    shape = dict(n=n_bin, entry_size=4, batch=8, prf_method=0,
+                 scheme="logn", radix=2)
+    c = TuningCache(tmp_cache)
+    c.store(cache_key("mesh", **shape, mesh="2x4"),
+            {"knobs": {"chunk_leaves": 32, "psum_group": 1}})
+    default_cache(refresh=True)
+    table = np.arange(128 * 4, dtype=np.int32).reshape(128, 4)
+    bins = [list(range(i * 16, (i + 1) * 16)) for i in range(8)]
+    srv = PrivateLookupServer(table, bins, prf=0, mesh=mesh)
+    kn = srv._group_knobs(n_bin, 8, "logn", 2)
+    assert kn["chunk_leaves"] == 32  # mesh-only cache: mesh entry used
+    srv_single = PrivateLookupServer(table, bins, prf=0)
+    kn = srv_single._group_knobs(n_bin, 8, "logn", 2)
+    assert kn["chunk_leaves"] == 128  # no entry at all: heuristic
+
+    c.store(cache_key("eval", **shape), {"knobs": {"chunk_leaves": 64}})
+    default_cache(refresh=True)
+    srv = PrivateLookupServer(table, bins, prf=0, mesh=mesh)
+    kn = srv._group_knobs(n_bin, 8, "logn", 2)
+    assert kn["chunk_leaves"] == 64  # single-device entry preferred
+
+
+def test_tune_mesh_eval_invalid_split_raises_value_error(
+        eight_devices, tmp_cache):
+    """An invalid split surfaces the underlying ValueError (not the
+    broken-baseline AssertionError), so a split race can record it as a
+    clean rejection and keep racing the other splits."""
+    import dpf_tpu
+    from dpf_tpu.parallel.sharded import make_mesh
+    from dpf_tpu.tune.mesh_tune import tune_mesh_eval
+    # block-PRG sqrt-N with R/shards = 2 < the 4-row interleave floor
+    mesh = make_mesh(n_table=8, n_batch=1)
+    with pytest.raises(ValueError):
+        tune_mesh_eval(512, 4, mesh=mesh,
+                       prf_method=dpf_tpu.PRF_CHACHA20_BLK,
+                       scheme="sqrtn", reps=1, distinct=2)
+
+
+def test_tune_mesh_shape_races_splits(eight_devices, tmp_cache):
+    """The split race reuses the per-split warm entries and records a
+    winner; lookup_mesh_split answers later processes."""
+    import jax
+    from dpf_tpu.tune.mesh_tune import (lookup_mesh_split,
+                                        tune_mesh_eval, tune_mesh_shape)
+    from dpf_tpu.parallel.sharded import make_mesh
+    devices = jax.devices()[:2]
+    # pre-warm one split: the race must hit its cache entry
+    tune_mesh_eval(512, 4, mesh=make_mesh(n_table=2, n_batch=1,
+                                          devices=devices),
+                   prf_method=0, reps=1, distinct=4)
+    rec = tune_mesh_shape(512, 4, devices=devices, prf_method=0, reps=1)
+    assert rec["searched"]
+    splits = rec["measured"]["splits"]
+    assert {(r["n_batch"], r["n_table"]) for r in splits} \
+        == {(1, 2), (2, 1)}
+    assert any(r.get("from_cache") for r in splits
+               if (r["n_batch"], r["n_table"]) == (1, 2))
+    win = lookup_mesh_split(n=512, entry_size=16, batch=4, prf_method=0,
+                            n_devices=2)
+    assert win == rec["knobs"]

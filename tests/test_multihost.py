@@ -25,6 +25,14 @@ def _free_port() -> int:
 
 
 def test_two_process_distributed_mesh():
+    from dpf_tpu.utils.compat import has_cpu_multiprocess
+    if not has_cpu_multiprocess():
+        # jaxlib 0.4.x's CPU client rejects multi-process computations
+        # ("Multiprocess computations aren't implemented on the CPU
+        # backend" from the first sharded device_put) — a toolchain
+        # gap, not a regression
+        pytest.skip("CPU backend has no multi-process computations on "
+                    "this jaxlib (needs the 0.5 line)")
     port = _free_port()
     env = dict(os.environ)
     env.pop("XLA_FLAGS", None)  # worker pins its own device count

@@ -139,6 +139,194 @@ def test_single_query_whole_mesh_latency_path(eight_devices):
     assert (rec[0] == table[2025]).all()
 
 
+# --------------------------------------------------- mesh-shape parity fuzz
+
+# (n_table, n_batch) — including the degenerate 1-device mesh and a
+# 2-device subset mesh: the sharded program must agree with the
+# single-device oracle bit for bit on EVERY split, not just full meshes
+PARITY_SHAPES = [(1, 1), (2, 1), (4, 2), (8, 1)]
+
+
+def _construction_dpf(label, prf):
+    from dpf_tpu.utils.config import EvalConfig
+    if label == "radix4":
+        return DPF(config=EvalConfig(prf_method=prf, radix=4))
+    return DPF(prf=prf, scheme="sqrtn" if label == "sqrtn" else "logn")
+
+
+def _parity_case(label, nt, nb, n, batch, prf, entry=5, seed=0):
+    """One fuzz cell: random table + random indices, sharded eval must
+    be bit-identical to the single-device path per server AND recover
+    the table rows across servers."""
+    import jax
+    rng = np.random.default_rng(seed ^ hash((label, nt, nb)) % (1 << 31))
+    dpf = _construction_dpf(label, prf)
+    table = rng.integers(-2 ** 31, 2 ** 31, (n, entry),
+                         dtype=np.int64).astype(np.int32)
+    idxs = [int(x) for x in rng.integers(0, n, batch)]
+    keys = [dpf.gen(i, n) for i in idxs]
+    dpf.eval_init(table)
+    single = np.asarray(dpf.eval_tpu([k[0] for k in keys]))
+    mesh = sharded.make_mesh(n_table=nt, n_batch=nb,
+                             devices=jax.devices()[:nt * nb])
+    srv = sharded.ShardedDPFServer(
+        table, mesh, prf_method=prf, batch_size=batch,
+        radix=4 if label == "radix4" else 2,
+        scheme="sqrtn" if label == "sqrtn" else "logn")
+    a = srv.eval([k[0] for k in keys])
+    b = srv.eval([k[1] for k in keys])
+    assert (a == single).all(), \
+        "%s mesh %dx%d diverged from the single-device oracle" \
+        % (label, nb, nt)
+    assert ((a - b).astype(np.int32) == table[idxs]).all()
+
+
+@pytest.mark.parametrize("mesh_shape", PARITY_SHAPES)
+@pytest.mark.parametrize("label", ["logn", "radix4", "sqrtn"])
+def test_mesh_parity_fuzz(eight_devices, label, mesh_shape):
+    nt, nb = mesh_shape
+    _parity_case(label, nt, nb, n=1024, batch=5, prf=DPF.PRF_SALSA20)
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DPF_RUN_SLOW"),
+    reason="large-N parity fuzz: minutes of 1-core XLA-CPU work; the "
+           "small-N cells above pin the same program legs")
+@pytest.mark.parametrize("label", ["logn", "radix4", "sqrtn"])
+def test_mesh_parity_fuzz_large(eight_devices, label):
+    _parity_case(label, 4, 2, n=1 << 16, batch=8, prf=DPF.PRF_CHACHA20,
+                 entry=16, seed=7)
+
+
+def test_sharded_chunked_psum_matches_terminal(eight_devices):
+    """psum_group variants are bit-identical to the terminal psum AND
+    the single-device oracle for all three constructions — int32 adds
+    wrap, so collective grouping must not change a single bit.  Every
+    cell here genuinely runs the grouped-psum scan (steps > 1 and the
+    group divides it; an invalid group silently degrades to the
+    terminal psum, which would make the comparison vacuous)."""
+    n, batch, prf = 2048, 4, DPF.PRF_DUMMY
+    rng = np.random.default_rng(3)
+    table = rng.integers(-2 ** 31, 2 ** 31, (n, 6),
+                         dtype=np.int64).astype(np.int32)
+    idxs = [1, 17, 1400, n - 1]
+    for label in ("logn", "radix4", "sqrtn"):
+        dpf = _construction_dpf(label, prf)
+        keys = [dpf.gen(i, n)[0] for i in idxs]
+        dpf.eval_init(table)
+        oracle = np.asarray(dpf.eval_tpu(keys))
+        kw = dict(prf_method=prf, batch_size=batch,
+                  radix=4 if label == "radix4" else 2,
+                  scheme="sqrtn" if label == "sqrtn" else "logn")
+        if label == "sqrtn":
+            # n=2048 -> K=64, R=32 -> 8 grid rows per shard with
+            # n_table=4: rc=4 -> steps=2, so psum_group=1 psums per step
+            mesh = sharded.make_mesh(n_table=4, n_batch=2)
+            knobs = [dict(row_chunk=4, psum_group=0),
+                     dict(row_chunk=4, psum_group=1)]
+        else:
+            # shard_rows=512, chunk 128 -> 4 chunks per shard
+            mesh = sharded.make_mesh(n_table=4, n_batch=2)
+            knobs = [dict(chunk_leaves=128, psum_group=0),
+                     dict(chunk_leaves=128, psum_group=1),
+                     dict(chunk_leaves=128, psum_group=2)]
+        outs = [sharded.ShardedDPFServer(table, mesh, **kw, **k).eval(keys)
+                for k in knobs]
+        assert (outs[0] == oracle).all(), label  # multi-step scan itself
+        for o in outs[1:]:
+            assert (o == outs[0]).all(), label
+
+
+def test_sharded_tuned_chunk_clamps_to_shard_rows(
+        eight_devices, monkeypatch, tmp_path):
+    """A tuned SINGLE-DEVICE chunk_leaves bigger than a shard's leaf
+    range must clamp against shard_rows (the per-shard heuristic), not
+    the full table; a mesh-tuned entry for this split wins over it; an
+    explicit ctor value wins over both."""
+    from dpf_tpu.tune.cache import TuningCache
+    from dpf_tpu.tune.fingerprint import cache_key
+    path = str(tmp_path / "tuning.json")
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", path)
+    n, batch, prf = 1024, 8, DPF.PRF_DUMMY
+    shape = dict(n=n, entry_size=7, batch=batch, prf_method=prf,
+                 scheme="logn", radix=2)
+    c = TuningCache(path)
+    c.store(cache_key("eval", **shape), {"knobs": {"chunk_leaves": 1024}})
+    table = np.zeros((n, 7), np.int32)
+    mesh = sharded.make_mesh(n_table=8, n_batch=1)
+    srv = sharded.ShardedDPFServer(table, mesh, prf_method=prf,
+                                   batch_size=batch)
+    kn = srv.resolved_eval_knobs(batch)
+    assert kn["chunk_leaves"] <= srv.shard_rows == 128
+    assert srv.shard_rows % kn["chunk_leaves"] == 0
+
+    # mesh-tuned (this device x mesh split) beats the single-device
+    # entry (fresh server: the lookups memoize per batch on hot paths)
+    c.store(cache_key("mesh", **shape, mesh="1x8"),
+            {"knobs": {"chunk_leaves": 32, "psum_group": 2}})
+    from dpf_tpu.tune.cache import default_cache
+    default_cache(refresh=True)
+    srv = sharded.ShardedDPFServer(table, mesh, prf_method=prf,
+                                   batch_size=batch)
+    kn = srv.resolved_eval_knobs(batch)
+    assert kn["chunk_leaves"] == 32 and kn["psum_group"] == 2
+
+    # explicit ctor pin beats the caches
+    srv2 = sharded.ShardedDPFServer(table, mesh, prf_method=prf,
+                                    batch_size=batch, chunk_leaves=64,
+                                    psum_group=0)
+    kn2 = srv2.resolved_eval_knobs(batch)
+    assert kn2["chunk_leaves"] == 64 and kn2["psum_group"] == 0
+
+
+def test_sharded_scheme_auto_resolves_from_cache(
+        eight_devices, monkeypatch, tmp_path):
+    """ShardedDPFServer(scheme='auto') resolves the construction the
+    DPF way: scheme tuning cache first, conservative logn heuristic on
+    a cold cache."""
+    from dpf_tpu.tune.cache import TuningCache, default_cache
+    from dpf_tpu.tune.search import scheme_cache_key
+    path = str(tmp_path / "tuning.json")
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", path)
+    default_cache(refresh=True)
+    n = 1024
+    table = np.zeros((n, 16), np.int32)
+    mesh = sharded.make_mesh(n_table=4, n_batch=2)
+    srv = sharded.ShardedDPFServer(table, mesh, prf_method=0,
+                                   scheme="auto")
+    assert (srv.scheme, srv.scheme_resolved_from) == ("logn", "heuristic")
+
+    c = TuningCache(path)
+    c.store(scheme_cache_key(n=n, entry_size=16, batch=8, prf_method=0),
+            {"knobs": {"scheme": "sqrtn", "radix": 2}})
+    default_cache(refresh=True)
+    srv = sharded.ShardedDPFServer(table, mesh, prf_method=0,
+                                   batch_size=8, scheme="auto")
+    assert (srv.scheme, srv.scheme_resolved_from) == ("sqrtn", "cache")
+    with pytest.raises(ValueError):
+        sharded.ShardedDPFServer(table, mesh, scheme="auto", radix=4)
+
+
+def test_sharded_sqrt_split_validation(eight_devices):
+    """Invalid sqrt-N shard splits fail fast with a clear error."""
+    from dpf_tpu.core import sqrtn
+    import jax
+    n = 512  # default split: K=32, R=16 -> R does not divide 32 shards
+    dpf = DPF(prf=DPF.PRF_DUMMY, scheme="sqrtn")
+    k1, _ = dpf.gen(3, n)
+    mesh = sharded.make_mesh(n_table=8, n_batch=1)
+    pk = sqrtn.decode_sqrt_keys_batched([k1])
+    # R=16 over 8 shards is fine; fake a narrower split via slicing R=4
+    bad = sqrtn.PackedSqrtKeys(pk.seeds, pk.cw1[:, :4], pk.cw2[:, :4],
+                               n=n)
+    with pytest.raises(ValueError, match="divide over"):
+        import numpy as _np
+        tbl = jax.numpy.asarray(_np.zeros((n, 4), _np.int32))
+        sqrtn.eval_sharded_sqrt(bad.seeds, bad.cw1, bad.cw2, tbl,
+                                prf_method=DPF.PRF_DUMMY, mesh=mesh,
+                                row_chunk=None)
+
+
 @pytest.mark.parametrize("mesh_shape", [(1, 8), (2, 4)])
 def test_sharded_radix4_matches_single_chip(eight_devices, mesh_shape):
     """Radix-4 construction over the mesh: recovery + bit-exact agreement
