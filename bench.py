@@ -90,7 +90,8 @@ def _worker_main(n):
     # Warm phase THROUGH THE API (same code path and jit caches the
     # measured run hits) with the cooperative deadline armed: every
     # per-level program compiles here, abortable between dispatches.
-    deadline = time.time() + SOFT_DEADLINE_S
+    # (monotonic — the dispatch deadline contract since the NTP fix)
+    deadline = time.monotonic() + SOFT_DEADLINE_S
     dpf = dpf_tpu.DPF(prf=dpf_tpu.PRF_AES128, config=cfg)
     k1, _ = dpf.gen(7, n)
     dpf.eval_init(np.zeros((n, 16), dtype=np.int32))

@@ -1,37 +1,45 @@
 # benchmark.py — sweep table sizes x PRFs and print dpfs/sec
 # (mirrors the reference's benchmark.py:1-7 sweep protocol).
 #
-# benchmark.py --serve runs the streaming serving benchmark instead
-# (blocking loop vs pipelined ServingEngine, dpf_tpu/serve/bench_serve.py).
+# Bench modes and their committed records:
 #
-# benchmark.py --autotune runs the hardware-aware autotuner
-# (dpf_tpu/tune/): staged coordinate descent over the fused-eval knobs
-# per (N, B) point plus a serving-knob grid search, every timed
-# candidate equality-gated against the scalar oracle; winners persist
-# in the tuning cache and the sweep record is written with --out
-# (committed as BENCH_TUNE_r07.json).  See docs/TUNING.md.
+#   flag               driver                       committed record
+#   (default sweep)    utils/bench.test_dpf_perf    BENCH_r0*.json
+#   --serve            serve/bench_serve.py         BENCH_SERVE_r06.json
+#   --autotune         tune/search.autotune_sweep   BENCH_TUNE_r07.json
+#   --autotune-scheme  tune/search.scheme_sweep     BENCH_SCHEME_r08.json
+#   --batch-pir        serve/bench_pir.py           BENCH_PIR_r09.json
+#   --multichip        serve/bench_multichip.py     MULTICHIP_r06.json
+#   --load             serve/bench_load.py          BENCH_LOAD_r10.json
 #
-# benchmark.py --autotune-scheme goes one level up: it races the three
-# constructions (logn vs radix-4 vs sqrtn) per (N, B) point — each
-# knob-tuned and equality-gated first — and persists the per-shape
-# winning construction in the same tuning cache (committed record:
-# BENCH_SCHEME_r08.json).
+# --serve: streaming serving benchmark (blocking loop vs pipelined
+# ServingEngine).  See docs/SERVING.md.
 #
-# benchmark.py --multichip runs the mesh rehearsal matrix
-# (dpf_tpu/serve/bench_multichip.py): all three constructions x every
-# mesh split x shape through the mesh autotuner (dpf_tpu/tune/
-# mesh_tune.py) on a forced-8-device CPU mesh (utils/hermetic.py) —
-# tuned vs mesh-heuristic, every timed candidate equality-gated
-# (committed record: MULTICHIP_r06.json); --native uses the real
-# device mesh and produces the relay TPU record with the same
-# command.  See docs/SHARDING.md.
+# --autotune: hardware-aware autotuner (dpf_tpu/tune/): staged
+# coordinate descent over the fused-eval knobs per (N, B) point plus a
+# serving-knob grid search, every timed candidate equality-gated
+# against the scalar oracle; winners persist in the tuning cache and
+# the sweep record is written with --out.  See docs/TUNING.md.
 #
-# benchmark.py --batch-pir runs the end-to-end batch-PIR benchmark
-# (dpf_tpu/serve/bench_pir.py): plan -> keygen -> answer -> recover on
-# the production path (batched keygen, packed group decode, tuned
-# knobs, async group dispatch, streaming engine) vs the pre-PR scalar
-# loops, equality-gated (committed record: BENCH_PIR_r09.json).  See
-# docs/BATCH_PIR.md.
+# --autotune-scheme: one level up — races the three constructions
+# (logn vs radix-4 vs sqrtn) per (N, B) point, each knob-tuned and
+# equality-gated first, and persists the per-shape winning
+# construction in the same tuning cache.
+#
+# --multichip: the mesh rehearsal matrix (all three constructions x
+# every mesh split x shape through the mesh autotuner) on a forced-
+# 8-device CPU mesh; --native uses the real device mesh and produces
+# the relay TPU record with the same command.  See docs/SHARDING.md.
+#
+# --batch-pir: end-to-end batch-PIR (plan -> keygen -> answer ->
+# recover on the production path vs the pre-PR scalar loops,
+# equality-gated).  See docs/BATCH_PIR.md.
+#
+# --load: traffic-shaped serving — the runtime cost-model scheme
+# router vs the sticky cached-winner engine over one seeded open-loop
+# bursty trace, with p50/p99 + deadline-miss/shed SLO accounting and
+# every served batch gated against the scalar oracle; --dryrun is the
+# seconds-long CI smoke.  See docs/SERVING.md "Load testing & SLOs".
 
 import sys
 
@@ -103,6 +111,10 @@ if __name__ == "__main__":
     if "--batch-pir" in sys.argv:
         from dpf_tpu.serve.bench_pir import main
         main([a for a in sys.argv[1:] if a != "--batch-pir"])
+        sys.exit(0)
+    if "--load" in sys.argv:
+        from dpf_tpu.serve.bench_load import main
+        main([a for a in sys.argv[1:] if a != "--load"])
         sys.exit(0)
     if "--autotune-scheme" in sys.argv:
         _autotune_scheme_main(

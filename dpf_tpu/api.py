@@ -188,9 +188,10 @@ class DPF(object):
         self.table_effective_entry_size = None
         self._torch_io = False
         self.buffers = None           # reference-API compat handle
-        # optional time.time() soft deadline for kernel_impl="dispatch":
-        # checked between per-level programs (never interrupts a compile —
-        # relay safety, docs/STATUS.md); used by bench warm-up
+        # optional time.monotonic() soft deadline for
+        # kernel_impl="dispatch": checked between per-level programs
+        # (never interrupts a compile — relay safety, docs/STATUS.md);
+        # used by bench warm-up
         self.dispatch_deadline = None
 
     # ------------------------------------------------------------------ gen

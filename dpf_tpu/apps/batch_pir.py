@@ -793,6 +793,17 @@ class LookupStream:
         return {"n%dxG%d" % (n, len(grp.idxs)): eng.stats.as_dict()
                 for n, grp, eng in self._engines}
 
+    def counters(self):
+        """All group engines' counters folded into ONE
+        ``EngineCounters`` (``merge``): the stream-level record —
+        total dispatches, pooled latency ring, shed/deadline counts —
+        without hand-copying fields per group."""
+        from ..utils.profiling import EngineCounters
+        agg = EngineCounters()
+        for _, _, eng in self._engines:
+            agg.merge(eng.stats)
+        return agg
+
 
 class PrivateLookupClient:
     """Generates per-bin keys for a planned fetch and recovers entries.

@@ -250,13 +250,15 @@ def eval_dispatch(cw1, cw2, last, table_perm, *, depth: int,
 
     group: frontier nodes expanded together per pass (default: as many as
     keep the live leaf tensor under ~2^18 x batch x 16 B).
-    deadline: optional time.time() value; checked between dispatches
-    (cooperative — raises DeadlineExceeded without interrupting a compile).
+    deadline: optional time.monotonic() value; checked between dispatches
+    (cooperative — raises DeadlineExceeded without interrupting a
+    compile).  Monotonic, not wall-clock: an NTP step must neither fire
+    the deadline spuriously nor starve it.
     """
     import time as _time
 
     def check_deadline():
-        if deadline is not None and _time.time() > deadline:
+        if deadline is not None and _time.monotonic() > deadline:
             raise DeadlineExceeded(
                 "eval_dispatch soft deadline passed between dispatches")
     n, e = table_perm.shape

@@ -731,7 +731,8 @@ def eval_dispatch_mixed(cw1, cw2, last, table_perm, *, n: int,
     from .expand import DeadlineExceeded, _group_contract
 
     def check_deadline():
-        if deadline is not None and _time.time() > deadline:
+        # monotonic like expand.eval_dispatch: NTP-step immune
+        if deadline is not None and _time.monotonic() > deadline:
             raise DeadlineExceeded(
                 "eval_dispatch soft deadline passed between dispatches")
 

@@ -1,12 +1,20 @@
 """Throughput-oriented serving subsystem.
 
 ``ServingEngine`` (engine.py) pipelines host packing against device
-execution under a bounded in-flight window; ``Buckets`` (buckets.py)
-bounds the compiled-program count under ragged batch sizes;
-``bench_serve.py`` measures sustained queries/sec for the blocking loop
-vs. the engine.  Constructed via ``DPF.serving_engine()`` or
+execution under a bounded in-flight window, with cooperative monotonic
+deadlines, a latency ring, and admission control (``LoadShed``);
+``Buckets`` (buckets.py) bounds the compiled-program count under ragged
+batch sizes; ``loadgen.py`` generates deterministic open-loop arrival
+traces (Poisson / bursty / diurnal / replay); ``SchemeRouter``
+(router.py) dispatches each arriving batch to the cheapest construction
+by a live cost model; ``bench_serve.py`` measures sustained queries/sec
+for the blocking loop vs. the engine and ``bench_load.py`` races the
+router against the sticky baseline under a traffic trace with SLO
+accounting.  Constructed via ``DPF.serving_engine()`` or
 ``ShardedDPFServer.serving_engine()``.
 """
 
 from .buckets import Buckets  # noqa: F401
-from .engine import EngineFuture, ServingEngine  # noqa: F401
+from .engine import EngineFuture, LoadShed, ServingEngine  # noqa: F401
+from .loadgen import Arrival, make_trace  # noqa: F401
+from .router import RouteDecision, SchemeRouter  # noqa: F401

@@ -42,6 +42,17 @@ from ..utils.profiling import EngineCounters
 from .buckets import Buckets
 
 
+class LoadShed(RuntimeError):
+    """Admission control rejected a batch instead of queueing it.
+
+    Raised by ``ServingEngine.submit`` when ``shed=True`` and either the
+    pending-future queue is at ``max_queue_depth`` or the engine's p99
+    latency estimate exceeds ``slo_s`` while a backlog exists.  The
+    batch was NOT dispatched — nothing to unwind; the caller (a router,
+    a front-end) answers the client with a retry/reject instead of
+    letting the queue grow past the SLO."""
+
+
 class _Part:
     """One dispatched (bucket-padded) chunk of a submitted batch."""
     __slots__ = ("dev", "n_real", "out")
@@ -59,12 +70,13 @@ class EngineFuture:
     submitted before it — has left the device, then returns the
     ``[batch, entry_size]`` int32 share array.
     """
-    __slots__ = ("_engine", "_parts", "_value")
+    __slots__ = ("_engine", "_parts", "_value", "_t0")
 
     def __init__(self, engine):
         self._engine = engine
         self._parts = []
         self._value = None
+        self._t0 = None     # submit-entry perf_counter (latency ring)
 
     def done(self) -> bool:
         return self._value is not None
@@ -89,17 +101,43 @@ class ServingEngine:
         mesh path, sizes should be multiples of the mesh "batch" axis or
         the dispatch pads further (still one program per bucket).
       warmup: precompile every bucket at construction.
+      max_queue_depth: admission bound on PENDING futures (batches
+        submitted but not yet resolved).  When reached, ``submit``
+        resolves the oldest future first (deeper backpressure than the
+        dispatch window) — or, with ``shed=True``, rejects the batch.
+      slo_s: target per-batch latency.  With ``shed=True``, a batch
+        arriving while the p99 of the latency ring exceeds ``slo_s``
+        AND a backlog exists is rejected (``LoadShed``) rather than
+        queued — an idle engine always admits, so shedding self-heals
+        once the backlog drains.
+      shed: reject (raise ``LoadShed``, counted in
+        ``stats.shed_batches/shed_queries``) instead of blocking when
+        admission control trips.
 
-    ``deadline`` (a ``time.time()`` value) is checked cooperatively
-    between dispatches and resolutions — never mid-compile (relay
-    safety, docs/STATUS.md) — raising ``expand.DeadlineExceeded``.
+    ``deadline`` (a ``time.monotonic()`` value — immune to NTP steps;
+    pass ``timeout_s`` to have the engine compute it) is checked
+    cooperatively between dispatches and resolutions — never mid-compile
+    (relay safety, docs/STATUS.md) — raising ``expand.DeadlineExceeded``
+    and counting the trip in ``stats.deadline_misses``.
     """
 
     def __init__(self, server, *, max_in_flight: int = 2, buckets=None,
-                 warmup: bool = False, deadline: float | None = None):
+                 warmup: bool = False, deadline: float | None = None,
+                 timeout_s: float | None = None,
+                 max_queue_depth: int | None = None,
+                 slo_s: float | None = None, shed: bool = False):
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1 (got %d)"
                              % max_in_flight)
+        if deadline is not None and timeout_s is not None:
+            raise ValueError(
+                "pass deadline (absolute time.monotonic()) or timeout_s "
+                "(relative), not both")
+        if timeout_s is not None:
+            deadline = time.monotonic() + timeout_s
+        if max_queue_depth is not None and max_queue_depth < 1:
+            raise ValueError("max_queue_depth must be >= 1 (got %d)"
+                             % max_queue_depth)
         n = getattr(server, "table_num_entries", None)
         if n is None:
             n = getattr(server, "n", None)
@@ -118,6 +156,9 @@ class ServingEngine:
                               else Buckets.default_sizes(cap))
         self.buckets = buckets
         self.deadline = deadline
+        self.max_queue_depth = max_queue_depth
+        self.slo_s = slo_s
+        self.shed = bool(shed)
         self.stats = EngineCounters()
         self._queue = deque()     # _Part refs, dispatch order, unresolved
         self._pending = deque()   # futures with unresolved parts, FIFO
@@ -141,13 +182,24 @@ class ServingEngine:
         The host-side work here is the vectorized decode and the bucket
         pad; the device program is enqueued asynchronously.  When the
         in-flight window is full, blocks on the oldest outstanding
-        dispatch first (backpressure).
+        dispatch first (backpressure).  Admission control
+        (``max_queue_depth``/``slo_s``) runs first: over the bound the
+        batch either waits on the oldest pending future or — with
+        ``shed=True`` — is rejected with ``LoadShed`` before any decode
+        or dispatch work happens.
         """
         self._check_deadline()
+        t_enter = time.perf_counter()
+        # pre-decoded packed batches (LookupStream) carry .batch
+        self._admit(getattr(keys, "batch", None) or len(keys))
         t0 = time.perf_counter()
         pk = self._server._decode_batch(keys)
         b = pk.batch
         fut = EngineFuture(self)
+        # the latency ring measures from submit ENTRY: a blocking
+        # admission wait is exactly the client-observed queueing the
+        # p99 SLO trigger exists to see (pack_time_s stays post-admit)
+        fut._t0 = t_enter
         try:
             for lo, hi in self.buckets.chunks(b):
                 self._check_deadline()
@@ -203,6 +255,8 @@ class ServingEngine:
             out = np.concatenate([p.out for p in parts])
         fut._value = np.ascontiguousarray(out[:, :self._out_width])
         fut._parts = []
+        if fut._t0 is not None:
+            self.stats.note_latency(time.perf_counter() - fut._t0)
 
     def _resolve_through(self, fut: EngineFuture):
         """Resolve futures FIFO until (and including) ``fut``."""
@@ -259,28 +313,51 @@ class ServingEngine:
             if knobs:
                 self.buckets = Buckets(knobs["buckets"])
                 self.max_in_flight = int(knobs["max_in_flight"])
+        for size in self.buckets.sizes:
+            np.asarray(self._server._dispatch_packed(
+                self._synthetic_packed(size)))
+
+    def _synthetic_packed(self, size: int):
+        """A zero-codeword packed batch with the exact array shapes real
+        traffic produces at this bucket size (warmup/probe input)."""
         from ..core.keygen import PackedKeys
-        depth = self._n.bit_length() - 1
-        sqrt_split = None
         if getattr(self._server, "scheme", "logn") == "sqrtn":
             from ..core import sqrtn
-            sqrt_split = sqrtn.default_split(self._n)
+            from ..core.sqrtn import PackedSqrtKeys
+            k, r = sqrtn.default_split(self._n)
+            return PackedSqrtKeys(
+                seeds=np.zeros((size, k, 4), dtype=np.uint32),
+                cw1=np.zeros((size, r, 4), dtype=np.uint32),
+                cw2=np.zeros((size, r, 4), dtype=np.uint32),
+                n=self._n)
+        return PackedKeys(
+            cw1=np.zeros((size, 64, 4), dtype=np.uint32),
+            cw2=np.zeros((size, 64, 4), dtype=np.uint32),
+            last=np.zeros((size, 4), dtype=np.uint32),
+            depth=self._n.bit_length() - 1, n=self._n)
+
+    def probe(self, reps: int = 1) -> dict:
+        """Measure one warmed dispatch per bucket size (seconds).
+
+        The router's cost-model seed (serve/router.py): each bucket's
+        program runs once untimed (compile/warm — a no-op when
+        ``warmup()`` already ran and the jit cache is hot), then
+        best-of-``reps`` timed blocking dispatches.  Synthetic
+        zero-codeword keys measure the same program real traffic runs
+        (the eval is data-independent).  Serving counters do not move.
+        Returns ``{bucket_size: seconds}``.
+        """
+        out = {}
         for size in self.buckets.sizes:
-            if sqrt_split is not None:
-                from ..core.sqrtn import PackedSqrtKeys
-                k, r = sqrt_split
-                pk = PackedSqrtKeys(
-                    seeds=np.zeros((size, k, 4), dtype=np.uint32),
-                    cw1=np.zeros((size, r, 4), dtype=np.uint32),
-                    cw2=np.zeros((size, r, 4), dtype=np.uint32),
-                    n=self._n)
-            else:
-                pk = PackedKeys(
-                    cw1=np.zeros((size, 64, 4), dtype=np.uint32),
-                    cw2=np.zeros((size, 64, 4), dtype=np.uint32),
-                    last=np.zeros((size, 4), dtype=np.uint32),
-                    depth=depth, n=self._n)
+            pk = self._synthetic_packed(size)
             np.asarray(self._server._dispatch_packed(pk))
+            best = float("inf")
+            for _ in range(max(1, reps)):
+                t0 = time.perf_counter()
+                np.asarray(self._server._dispatch_packed(pk))
+                best = min(best, time.perf_counter() - t0)
+            out[size] = best
+        return out
 
     # ------------------------------------------------------------ plumbing
 
@@ -301,9 +378,41 @@ class ServingEngine:
         return d
 
     def _check_deadline(self):
-        if self.deadline is not None and time.time() > self.deadline:
+        # monotonic, not wall-clock: an NTP step must neither fire the
+        # deadline spuriously nor starve it forever
+        if self.deadline is not None and time.monotonic() > self.deadline:
+            self.stats.deadline_misses += 1
             raise DeadlineExceeded(
                 "serving-engine deadline passed between dispatches")
+
+    def _admit(self, n_queries: int):
+        """Admission control, before any decode/dispatch work.
+
+        Two triggers: the pending-future queue at ``max_queue_depth``,
+        or (``slo_s`` set) the ring's p99 latency estimate over the SLO
+        while a backlog exists.  ``shed=True`` rejects (``LoadShed``);
+        otherwise the engine blocks on the oldest pending future until
+        the queue is back under the bound (the p99 trigger never
+        blocks — waiting would only worsen the latency it guards).
+        """
+        over_depth = (self.max_queue_depth is not None
+                      and len(self._pending) >= self.max_queue_depth)
+        over_slo = False
+        if self.slo_s is not None and (self._pending or self._queue):
+            p99 = self.stats.p99
+            over_slo = p99 is not None and p99 > self.slo_s
+        if self.shed and (over_depth or over_slo):
+            self.stats.shed_batches += 1
+            self.stats.shed_queries += n_queries
+            raise LoadShed(
+                "admission control rejected the batch (%s; pending=%d, "
+                "p99=%s, slo_s=%s)"
+                % ("queue depth" if over_depth else "p99 over SLO",
+                   len(self._pending), self.stats.p99, self.slo_s))
+        while (self.max_queue_depth is not None
+               and len(self._pending) >= self.max_queue_depth):
+            self._check_deadline()
+            self._resolve_through(self._pending[0])
 
     @property
     def in_flight(self) -> int:

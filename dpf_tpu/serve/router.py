@@ -1,0 +1,415 @@
+"""Runtime cost-model scheme router: pick the construction per batch.
+
+The scheme-level autotuner answers "which construction is fastest for
+this (N, E, B) shape" *once*, offline, at one batch size — and
+``DPF(scheme="auto")`` then serves every batch with that sticky winner.
+Under real traffic that choice is wrong part of the time: the fastest
+construction changes with the batch size a burst actually delivers
+(BENCH_SCHEME_r08.json's winners flip across (N, B) points), so a
+bursty mixed-shape stream served sticky leaves qps and p99 on the
+table.  ``SchemeRouter`` switches constructions at *runtime* by a live
+cost model — the mid-pipeline scheme switching move of Chameleon
+(PAPERS.md arXiv:2410.05934) applied to the DPF serving stack:
+
+* One prepared server + ``ServingEngine`` per construction (binary GGM,
+  radix-4, sqrt-N) over the SAME table, all sharing one bucket ladder
+  so their per-bucket costs are comparable.
+* A cost model ``(construction, bucket) -> EWMA seconds``, seeded from
+  the tuning cache (``tune.lookup_scheme`` — the sweep's sticky winner
+  and, when present, its per-construction measured seconds) and from
+  startup probe dispatches (``ServingEngine.probe``), then updated
+  online by the observed service time of every routed batch.
+* ``route(batch)`` picks the cheapest construction for the batch's
+  bucket once every enabled construction has an estimate; until then it
+  falls back to the *sticky* cached winner (cold tuning cache: the
+  conservative heuristic) — ``routed_from`` says which path answered,
+  mirroring ``DPF.scheme_resolved_from``.
+
+Every routed answer is a plain engine result over that construction's
+keys, so it stays equality-gateable against the scalar oracle
+(``DPF.eval_cpu``); the load harness (``serve/bench_load.py``) gates
+every batch.  Keys are construction-specific: callers ``route`` first,
+mint/fetch keys for ``decision.construction`` (``router.server(label)``
+mints them), then ``submit(decision, keys)``.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..utils.profiling import EngineCounters
+from .buckets import Buckets
+from .engine import ServingEngine
+
+#: construction labels the router can serve, in race order
+LABELS = ("logn", "radix4", "sqrtn")
+
+
+def build_servers(table, labels=LABELS, *, prf_method: int) -> dict:
+    """One prepared ``api.DPF`` per construction label over ``table`` —
+    THE construction-spelling map (label -> ctor arguments), shared by
+    the router and the router tuner so they can never drift apart."""
+    from ..api import DPF
+    from ..utils.config import EvalConfig
+    servers = {}
+    for lb in labels:
+        if lb == "radix4":
+            srv = DPF(config=EvalConfig(prf_method=prf_method, radix=4))
+        elif lb == "sqrtn":
+            srv = DPF(prf=prf_method, scheme="sqrtn")
+        elif lb == "logn":
+            srv = DPF(prf=prf_method)
+        else:
+            raise ValueError("unknown construction %r (one of %s)"
+                             % (lb, ", ".join(LABELS)))
+        srv.eval_init(table)
+        servers[lb] = srv
+    return servers
+
+
+def resolve_sticky(n: int, entry_size: int, prf_method: int, cap: int,
+                   available=LABELS) -> tuple:
+    """(construction label, resolved_from) the sticky
+    ``DPF(scheme="auto")`` resolution would pin for this shape — THE
+    one spelling of that rule (cache winner with nearest-batch
+    fallback, else the conservative heuristic), shared by the router's
+    fallback and the load benchmark's baseline so they can never
+    diverge."""
+    from ..tune.cache import lookup_scheme
+    from ..tune.search import heuristic_scheme
+    try:
+        knobs = lookup_scheme(n=n, entry_size=entry_size, batch=cap,
+                              prf_method=prf_method)
+    except Exception:           # cache must never break serving
+        knobs = None
+    if knobs:
+        win = knobs.get("construction")
+        if win is None:         # pre-label records spell scheme/radix
+            win = ("radix4" if knobs.get("radix") == 4
+                   else knobs.get("scheme"))
+        if win in available:
+            return win, "cache"
+    hs = heuristic_scheme(n)
+    label = "radix4" if hs["radix"] == 4 else hs["scheme"]
+    if label not in available:
+        label = tuple(available)[0]
+    return label, "heuristic"
+
+
+class RouteDecision:
+    """One routing answer: which construction serves this batch, and
+    why (``routed_from``: "cost-model" once the model has an estimate
+    for every construction at this bucket, else "cache"/"heuristic" —
+    the sticky fallback's own provenance)."""
+    __slots__ = ("construction", "routed_from", "bucket", "batch")
+
+    def __init__(self, construction, routed_from, bucket, batch):
+        self.construction = construction
+        self.routed_from = routed_from
+        self.bucket = bucket
+        self.batch = batch
+
+    def __repr__(self):
+        return ("RouteDecision(%s, from=%s, bucket=%d, batch=%d)"
+                % (self.construction, self.routed_from, self.bucket,
+                   self.batch))
+
+
+class RoutedFuture:
+    """Engine future + the cost-model feedback loop: ``result()``
+    resolves the underlying dispatch and folds the observed service
+    time (submit→result, per dispatched chunk) back into the router's
+    EWMA for (construction, bucket)."""
+    __slots__ = ("_router", "_fut", "decision", "_t0", "_chunks",
+                 "_observed")
+
+    def __init__(self, router, fut, decision, t0, chunks):
+        self._router = router
+        self._fut = fut
+        self.decision = decision
+        self._t0 = t0
+        self._chunks = chunks
+        self._observed = False
+
+    def done(self) -> bool:
+        return self._fut.done()
+
+    def result(self):
+        out = self._fut.result()
+        if not self._observed:
+            self._observed = True
+            dt = (time.perf_counter() - self._t0) / max(1, self._chunks)
+            self._router._observe(self.decision.construction,
+                                  self.decision.bucket, dt)
+        return out
+
+
+class SchemeRouter:
+    """Serve one table through per-construction engines, routed live.
+
+    Args:
+      table: the [N, E] int32 table (uploaded once per construction —
+        each has its own device layout: bit-reversed, radix-4 mixed
+        order, or natural for sqrt-N).
+      prf: PRF id shared by all constructions.
+      constructions: subset of ``LABELS`` to race (default all three).
+      cap / buckets / max_in_flight: the shared engine knobs (one
+        ladder for every engine — per-bucket costs must compare).  When
+        ``buckets`` is None the tuned router ladder is consulted first
+        (``tune.serve_tune.lookup_router_knobs``), then the default /2
+        ladder.
+      ewma_alpha: weight of each new observation in the cost model.
+      probe: measure one warmed dispatch per (construction, bucket) at
+        startup to seed the cost model (compile cost is paid here, like
+        ``warmup``).  ``probe=False`` starts cold: routing falls back
+        to the sticky cached winner until observations accumulate.
+      slo_s / max_queue_depth / shed: per-engine admission control
+        (docs/SERVING.md "Load testing & SLOs").
+
+    ``routed_from`` mirrors ``DPF.scheme_resolved_from``: the provenance
+    of the most recent routing decision ("cost-model", "cache", or
+    "heuristic"); per-decision provenance rides on ``RouteDecision``.
+    """
+
+    def __init__(self, table, *, prf=None, constructions=None,
+                 cap: int | None = None, buckets=None,
+                 max_in_flight: int = 2, ewma_alpha: float = 0.25,
+                 warmup: bool = True, probe: bool = True,
+                 probe_reps: int = 1, slo_s: float | None = None,
+                 max_queue_depth: int | None = None, shed: bool = False,
+                 servers: dict | None = None):
+        from ..api import DPF
+        if not 0 < ewma_alpha <= 1:
+            raise ValueError("ewma_alpha must be in (0, 1] (got %r)"
+                             % (ewma_alpha,))
+        labels = tuple(constructions if constructions is not None
+                       else (servers.keys() if servers else LABELS))
+        for lb in labels:
+            if lb not in LABELS:
+                raise ValueError("unknown construction %r (one of %s)"
+                                 % (lb, ", ".join(LABELS)))
+        if not labels:
+            raise ValueError("need at least one construction")
+        self.constructions = labels
+        self.ewma_alpha = float(ewma_alpha)
+        if servers is not None:
+            # prepared servers shared across routers (the tuner builds
+            # its candidate routers over ONE table upload per scheme)
+            missing = [lb for lb in labels if lb not in servers]
+            if missing:
+                raise ValueError("servers missing constructions %s"
+                                 % (missing,))
+            self._servers = {lb: servers[lb] for lb in labels}
+            self.prf_method = self._servers[labels[0]].prf_method
+        else:
+            self.prf_method = DPF.DEFAULT_PRF if prf is None else prf
+            self._servers = build_servers(table, labels,
+                                          prf_method=self.prf_method)
+        any_srv = self._servers[labels[0]]
+        self.n = any_srv.table_num_entries
+        self.entry_size = any_srv.table_effective_entry_size
+        cap = int(cap or min(any_srv.BATCH_SIZE, 512))
+        if buckets is None:
+            from ..tune.serve_tune import lookup_router_knobs
+            knobs = lookup_router_knobs(self, cap)
+            if knobs:
+                buckets = knobs["buckets"]
+                max_in_flight = int(knobs["max_in_flight"])
+                self.ewma_alpha = float(knobs.get("ewma_alpha",
+                                                  self.ewma_alpha))
+        self.buckets = (buckets if isinstance(buckets, Buckets)
+                        else Buckets(buckets if buckets is not None
+                                     else Buckets.default_sizes(cap)))
+        self.engines = {
+            lb: ServingEngine(srv, max_in_flight=max_in_flight,
+                              buckets=self.buckets,
+                              max_queue_depth=max_queue_depth,
+                              slo_s=slo_s, shed=shed)
+            for lb, srv in self._servers.items()}
+        # ---- sticky fallback + cost-model seed from the tuning cache
+        self._costs = {}            # (label, bucket) -> EWMA seconds
+        self._obs_age = {}          # (label, bucket) -> routes at this
+        #                             bucket since that label was last
+        #                             OBSERVED (exploration clock)
+        self.sticky, self.sticky_resolved_from = self._resolve_sticky()
+        self.routed_from = self.sticky_resolved_from
+        self.route_counts = {lb: 0 for lb in labels}
+        self.routed_from_counts = {}
+        if warmup or probe:
+            self.warmup(probe=probe, probe_reps=probe_reps)
+
+    # -------------------------------------------------------- cost model
+
+    def _resolve_sticky(self):
+        """``resolve_sticky`` for this router's shape (the
+        ``DPF._ensure_scheme``-equivalent winner, nearest tuned batch
+        included), plus: an EXACT cap-batch scheme-sweep entry seeds
+        the cost model with its per-construction measured seconds at
+        the cap bucket (a measured-at-another-batch record still
+        answers "which construction" but its magnitudes would mis-seed
+        the EWMA)."""
+        from ..tune.cache import default_cache
+        from ..tune.search import scheme_cache_key
+        cap = self.buckets.max
+        try:
+            # .lookup, not .entries.get: every cache consultation must
+            # move CACHE_COUNTERS (the warm-start observability
+            # contract of tune/cache.py)
+            exact = default_cache().lookup(scheme_cache_key(
+                n=self.n, entry_size=self.entry_size, batch=cap,
+                prf_method=self.prf_method))
+            if exact:
+                for row in (exact.get("measured", {})
+                            .get("per_construction", ())):
+                    lb = row.get("construction")
+                    if lb in self._servers and row.get("tuned_s"):
+                        self._costs[(lb, cap)] = float(row["tuned_s"])
+        except Exception:       # cache must never break serving
+            pass
+        return resolve_sticky(self.n, self.entry_size, self.prf_method,
+                              cap, available=self.constructions)
+
+    #: routes at a bucket before a never-re-observed construction gets
+    #: one exploration dispatch: the EWMA only updates for the routed
+    #: construction, so a single inflated observation (client deferred
+    #: result(), a load transient) would otherwise lock a construction
+    #: out of the argmin FOREVER — periodic re-measurement bounds the
+    #: staleness at ~EXPLORE_EVERY batches per bucket.  256 keeps the
+    #: exploration tax ~1% of routes (an explore dispatches a possibly
+    #: slower construction mid-burst, which shows up directly in p99)
+    #: while still re-measuring within seconds under load
+    EXPLORE_EVERY = 256
+
+    def _observe(self, label: str, bucket: int, seconds: float):
+        """Fold one observed per-dispatch service time into the EWMA."""
+        key = (label, bucket)
+        cur = self._costs.get(key)
+        self._costs[key] = (seconds if cur is None else
+                            self.ewma_alpha * seconds
+                            + (1 - self.ewma_alpha) * cur)
+        self._obs_age[key] = 0
+
+    def cost(self, label: str, bucket: int) -> float | None:
+        """Current per-dispatch estimate (seconds), None when unknown."""
+        return self._costs.get((label, bucket))
+
+    # ----------------------------------------------------------- routing
+
+    def route(self, batch: int) -> RouteDecision:
+        """Pick the construction for a ``batch``-query arrival.
+
+        Cost-model routing needs an estimate for EVERY enabled
+        construction at the batch's bucket (comparing a measured
+        construction against unmeasured ones would lock onto whichever
+        happened to be observed first); anything less falls back to the
+        sticky cached winner — cold tuning cache included, where the
+        sticky answer is the heuristic and ``routed_from`` says so.
+        Every ~``EXPLORE_EVERY`` routes at a bucket, the construction
+        whose estimate is stalest gets the batch instead of the argmin
+        (``routed_from="explore"``) so its EWMA re-measures and a
+        poisoned estimate self-corrects.
+        """
+        if batch < 1:
+            raise ValueError("batch must be >= 1 (got %d)" % batch)
+        bucket = (self.buckets.bucket_for(batch)
+                  if batch <= self.buckets.max else self.buckets.max)
+        costs = {lb: self._costs.get((lb, bucket))
+                 for lb in self.constructions}
+        if all(c is not None for c in costs.values()):
+            for lb in self.constructions:
+                self._obs_age[(lb, bucket)] = (
+                    self._obs_age.get((lb, bucket), 0) + 1)
+            stalest = max(self.constructions,
+                          key=lambda lb: self._obs_age[(lb, bucket)])
+            if self._obs_age[(stalest, bucket)] >= self.EXPLORE_EVERY:
+                label, routed_from = stalest, "explore"
+                # reset the clock at ROUTE time, not observation time:
+                # with deferred result() every in-flight route at this
+                # bucket would otherwise re-trigger the same explore —
+                # a window-sized storm of the possibly-slowest
+                # construction mid-burst
+                self._obs_age[(stalest, bucket)] = 0
+            else:
+                label = min(costs, key=costs.get)
+                routed_from = "cost-model"
+        else:
+            label, routed_from = self.sticky, self.sticky_resolved_from
+        self.routed_from = routed_from
+        self.route_counts[label] += 1
+        self.routed_from_counts[routed_from] = (
+            self.routed_from_counts.get(routed_from, 0) + 1)
+        return RouteDecision(label, routed_from, bucket, batch)
+
+    def submit(self, decision: RouteDecision, keys) -> RoutedFuture:
+        """Dispatch ``keys`` (minted for ``decision.construction`` —
+        ``server(label).gen``) through that construction's engine;
+        returns a ``RoutedFuture`` whose resolution feeds the observed
+        service time back into the cost model."""
+        engine = self.engines[decision.construction]
+        chunks = len(engine.buckets.chunks(len(keys)))
+        t0 = time.perf_counter()
+        fut = engine.submit(keys)
+        return RoutedFuture(self, fut, decision, t0, chunks)
+
+    # ---------------------------------------------------------- plumbing
+
+    def server(self, label: str):
+        """The prepared ``api.DPF`` serving one construction (also the
+        key-minting client and the scalar-oracle reference for it)."""
+        return self._servers[label]
+
+    def warmup(self, probe: bool = True, probe_reps: int = 1) -> None:
+        """Precompile every (construction, bucket) program; with
+        ``probe`` also seed the cost model from one timed dispatch each
+        (``ServingEngine.probe``)."""
+        for lb, engine in self.engines.items():
+            engine.warmup()
+            if probe:
+                for size, dt in engine.probe(reps=probe_reps).items():
+                    self._observe(lb, size, dt)
+
+    def drain(self) -> None:
+        """Resolve every outstanding dispatch across all engines."""
+        for engine in self.engines.values():
+            engine.drain()
+
+    def reset_counters(self) -> None:
+        """Zero routing counts and every engine's counters (bench reps
+        measure fresh); the LEARNED state — the cost model and sticky
+        resolution — is kept."""
+        for engine in self.engines.values():
+            engine.stats.reset()
+        self.route_counts = {lb: 0 for lb in self.constructions}
+        self.routed_from_counts = {}
+
+    def counters(self) -> EngineCounters:
+        """All engines' counters merged into one record
+        (``EngineCounters.merge``) — the router-level SLO view."""
+        agg = EngineCounters()
+        for engine in self.engines.values():
+            agg.merge(engine.stats)
+        return agg
+
+    def stats(self) -> dict:
+        """Routing + serving diagnostics for benchmark records."""
+        return {
+            "constructions": list(self.constructions),
+            "sticky": self.sticky,
+            "sticky_resolved_from": self.sticky_resolved_from,
+            "routed_from": self.routed_from,
+            "route_counts": dict(self.route_counts),
+            "routed_from_counts": dict(self.routed_from_counts),
+            "cost_model_ms": {
+                "%s@%d" % (lb, bk): round(s * 1e3, 4)
+                for (lb, bk), s in sorted(self._costs.items())},
+            "buckets": list(self.buckets.sizes),
+            "counters": self.counters().as_dict(),
+            "per_engine": {lb: e.stats.as_dict()
+                           for lb, e in self.engines.items()},
+        }
+
+    def __repr__(self):
+        return ("SchemeRouter(n=%d, constructions=%s, sticky=%s/%s, "
+                "routed=%s)" % (self.n, list(self.constructions),
+                                self.sticky, self.sticky_resolved_from,
+                                dict(self.route_counts)))

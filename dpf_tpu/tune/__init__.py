@@ -32,4 +32,5 @@ from .search import (  # noqa: F401
     autotune_sweep, heuristic_knobs, scheme_sweep, stage_candidates,
     tune_eval)
 from .serve_tune import (  # noqa: F401
-    lookup_serve_knobs, synthetic_trace, tune_serving)
+    lookup_router_knobs, lookup_serve_knobs, synthetic_trace,
+    tune_router, tune_serving)

@@ -1,17 +1,27 @@
-"""Serving-knob tuner: bucket ladder x in-flight window vs a synthetic
-arrival trace.
+"""Serving-knob tuner: bucket ladder x in-flight window vs an arrival
+trace.
 
 The engine's two knobs trade compile count, pad waste, and host/device
 overlap: a dense ladder wastes less padding but compiles more programs
 and reuses each less; a deeper in-flight window hides more host time on
 an async backend but buys nothing on a synchronous one.  Neither is
 predictable from first principles across backends — so, like the eval
-knobs, they are *measured*: a deterministic synthetic trace of ragged
-batch sizes is replayed through every (ladder, max_in_flight) candidate
-(grid search — the space is tiny), each candidate's outputs are
+knobs, they are *measured*: a deterministic trace of ragged batch sizes
+is replayed through every (ladder, max_in_flight) candidate (grid
+search — the space is tiny), each candidate's outputs are
 equality-gated against the blocking ``eval_tpu`` loop on the identical
 stream, and the sustained-qps winner persists in the tuning cache under
 the ``serve|...`` key.
+
+The trace can be any ``serve.loadgen`` trace (``trace=`` an ``Arrival``
+list or a plain size list, or ``trace_kind="poisson"/"bursty"/
+"diurnal"`` for the canonical defaults) — tune against the traffic
+shape you expect; the legacy ``synthetic_trace`` remains the
+compatibility default.  ``tune_router`` extends the same protocol one
+level up: it races (ladder x in-flight x EWMA alpha) for the runtime
+scheme router (``serve/router.py``) against a chosen trace and persists
+the winner under the ``router|...`` key (``lookup_router_knobs`` reads
+it back at router construction).
 
 ``ServingEngine.warmup(tune=True)`` consults the cache first and only
 searches on a miss (and only when its server can mint keys — the plain
@@ -44,6 +54,33 @@ def synthetic_trace(cap: int, batches: int = 16, seed: int = 7) -> list:
         else:
             sizes.append(int(rng.integers(1, cap + 1)))
     return sizes
+
+
+def resolve_trace(cap: int, trace=None, trace_kind: str | None = None,
+                  trace_kw: dict | None = None) -> list:
+    """The tuner's trace input, as a batch-size list.
+
+    Exactly one source: an explicit ``trace`` (``loadgen.Arrival`` list
+    or plain sizes), or a ``trace_kind`` string resolved through
+    ``serve.loadgen`` (``trace_kw`` forwards to ``make_trace``; without
+    it the kind's canonical default trace is used).  Neither given =
+    the legacy ``synthetic_trace`` (compatibility default)."""
+    from ..serve import loadgen
+    if trace is not None and trace_kind is not None:
+        raise ValueError("pass trace OR trace_kind, not both")
+    if trace_kw and trace_kind is None:
+        raise ValueError("trace_kw only parameterizes trace_kind")
+    if trace_kind is not None:
+        if trace_kw:
+            kw = {"cap": cap, **trace_kw}
+            if trace_kind == "replay":   # replay_trace takes no cap
+                kw.pop("cap", None)
+            trace = loadgen.make_trace(trace_kind, **kw)
+        else:
+            trace = loadgen.default_trace(trace_kind, cap)
+    if trace is None:
+        return synthetic_trace(cap)
+    return loadgen.batch_sizes(trace)
 
 
 def serve_shape_of(server) -> dict:
@@ -84,12 +121,22 @@ def lookup_serve_knobs(server, cap: int,
 
 
 def tune_serving(dpf, *, cap: int | None = None, trace=None,
+                 trace_kind: str | None = None,
+                 trace_kw: dict | None = None,
                  in_flight=(1, 2, 4), ladders=None, reps: int = 2,
                  distinct: int = 16, cache: TuningCache | None = None,
                  force: bool = False, log=None) -> dict:
     """Measure (ladder, max_in_flight) candidates on ``dpf`` (a prepared
     ``api.DPF``) and persist the winner.  Returns the cache record with
-    a transient ``searched`` field (False = warm cache, nothing ran)."""
+    a transient ``searched`` field (False = warm cache, nothing ran).
+
+    ``trace``/``trace_kind`` choose the replayed workload
+    (``resolve_trace``): a ``serve.loadgen`` trace tunes the ladder for
+    the traffic shape you expect; the default stays the legacy
+    ``synthetic_trace``.  An EXPLICIT trace always re-measures: the
+    cache key carries only the table shape, so a warm entry tuned on a
+    different workload must not masquerade as this one's answer (the
+    stored record's ``measured.trace`` says what was replayed)."""
     from ..serve.buckets import Buckets
     from ..serve.engine import ServingEngine
 
@@ -97,13 +144,13 @@ def tune_serving(dpf, *, cap: int | None = None, trace=None,
     shape = serve_shape_of(dpf)
     cap = int(cap or min(dpf.BATCH_SIZE, 512))
     key = cache_key("serve", batch=cap, **shape)
-    if not force:
+    if not force and trace is None and trace_kind is None:
         rec = cache.lookup(key)
         if rec is not None:
             return {**rec, "searched": False}
 
     n = shape["n"]
-    trace = list(trace) if trace is not None else synthetic_trace(cap)
+    trace = resolve_trace(cap, trace, trace_kind, trace_kw)
     if max(trace) > cap:
         raise ValueError("trace batch %d exceeds cap %d"
                          % (max(trace), cap))
@@ -194,3 +241,158 @@ def tune_serving_shape(*, n: int, cap: int, entry_size: int = 16,
         "rejected": m["rejected"],
         "from_cache": not rec["searched"],
     }
+
+
+# --------------------------------------------------------- scheme router
+
+
+def router_cache_key(*, n: int, entry_size: int, batch: int,
+                     prf_method: int) -> str:
+    """Tuning-cache key for the scheme router's knobs.  Like the
+    scheme-winner key, the construction is the router's runtime ANSWER
+    (it changes per batch), not part of the shape — scheme/radix pin to
+    the ``any``/0 sentinels."""
+    return cache_key("router", n=n, entry_size=entry_size, batch=batch,
+                     prf_method=prf_method, scheme="any", radix=0)
+
+
+def lookup_router_knobs(router, cap: int,
+                        cache: TuningCache | None = None) -> dict | None:
+    """Tuned router knobs (buckets, max_in_flight, ewma_alpha) for this
+    table shape, or None.  ``router`` is anything exposing
+    n / entry_size / prf_method (a ``serve.router.SchemeRouter`` mid-
+    construction, or a prepared server).  Never raises — an unreadable
+    cache is a miss."""
+    try:
+        cache = cache if cache is not None else default_cache()
+        n = getattr(router, "n", None) or router.table_num_entries
+        e = (getattr(router, "entry_size", None)
+             or router.table_effective_entry_size)
+        rec = cache.lookup(router_cache_key(
+            n=int(n), entry_size=int(e), batch=cap,
+            prf_method=router.prf_method))
+        return rec.get("knobs") if rec else None
+    except Exception:  # pragma: no cover — cache must never break serving
+        return None
+
+
+def tune_router(table, *, prf_method: int = 0, cap: int | None = None,
+                trace=None, trace_kind: str | None = None,
+                trace_kw: dict | None = None, in_flight=(1, 2),
+                ladders=None, alphas=(0.25,), reps: int = 2,
+                distinct: int = 8, cache: TuningCache | None = None,
+                force: bool = False, log=None) -> dict:
+    """Tune the scheme router's switch machinery against a chosen trace.
+
+    Grid-searches (bucket ladder x ``max_in_flight`` x ``ewma_alpha``)
+    for a ``serve.router.SchemeRouter`` over ``table``, replaying the
+    trace's batch sizes back-to-back through each candidate (all three
+    constructions prepared ONCE and shared across candidates).  Every
+    candidate's every routed answer is equality-gated against the
+    scalar oracle (``DPF.eval_cpu`` references, the load harness's key
+    pools — ``bench_load._key_pool``); the elapsed-time winner
+    persists under the ``router|...`` key, which
+    ``SchemeRouter(buckets=None)`` consults at construction.  Like
+    ``tune_serving``, an explicit trace always re-measures.
+    """
+    import dpf_tpu
+    from ..serve.bench_load import _batch_for, _key_pool
+    from ..serve.buckets import Buckets
+    from ..serve.router import LABELS, SchemeRouter, build_servers
+
+    cache = cache if cache is not None else default_cache()
+    table = np.asarray(table, dtype=np.int32)
+    n, entry_size = table.shape
+    cap = int(cap or min(dpf_tpu.DPF.BATCH_SIZE, 512))
+    key = router_cache_key(n=n, entry_size=entry_size, batch=cap,
+                           prf_method=prf_method)
+    if not force and trace is None and trace_kind is None:
+        rec = cache.lookup(key)
+        if rec is not None:
+            return {**rec, "searched": False}
+
+    trace = resolve_trace(cap, trace, trace_kind, trace_kw)
+    if max(trace) > cap:
+        raise ValueError("trace batch %d exceeds cap %d"
+                         % (max(trace), cap))
+    total = sum(trace)
+    # one table upload per construction, shared by every candidate
+    # (the router's own construction-spelling map, so the tuner can
+    # never measure a differently-configured server than it tunes);
+    # the key pools + scalar-oracle references are the load harness's
+    # own machinery — one spelling across both harnesses
+    servers = build_servers(table, LABELS, prf_method=prf_method)
+    pools = {lb: _key_pool(srv, n, distinct,
+                           b"router-tune-%s" % lb.encode())
+             for lb, srv in servers.items()}
+
+    def key_batch(lb, j, b):
+        return _batch_for(pools[lb], j, b)
+
+    candidates = []
+    for ladder in (ladders if ladders is not None
+                   else Buckets.ladder_candidates(cap)):
+        for mif in in_flight:
+            for alpha in alphas:
+                candidates.append((tuple(ladder), int(mif),
+                                   float(alpha)))
+    best = None
+    tried = rejected = 0
+    for ladder, mif, alpha in candidates:
+        tried += 1
+        try:
+            elapsed, stats = float("inf"), None
+            for _ in range(reps):
+                router = SchemeRouter(
+                    None, servers=servers, buckets=ladder,
+                    max_in_flight=mif, ewma_alpha=alpha, cap=cap)
+                t0 = time.perf_counter()
+                outs = []
+                for j, b in enumerate(trace):
+                    dec = router.route(b)
+                    keys, idxs = key_batch(dec.construction, j, b)
+                    outs.append((dec, idxs, router.submit(dec, keys)))
+                for _, _, fut in outs:
+                    fut.result()
+                rep_s = time.perf_counter() - t0
+                if rep_s < elapsed:   # keep the stats OF the kept rep
+                    elapsed, stats = rep_s, router.stats()
+                # gate EVERY rep: the probe-seeded cost model varies
+                # run to run, so different reps can route batches to
+                # different (construction, bucket) programs — a winner
+                # marked "gated" must have had every program it ran
+                # checked (results are already materialized; the gate
+                # is an index + compare per batch)
+                for dec, idxs, fut in outs:
+                    ref = pools[dec.construction][1][idxs]
+                    if not np.array_equal(fut.result(), ref):
+                        raise AssertionError("routed answers diverged")
+        except Exception as exc:
+            rejected += 1
+            if log:
+                log("  reject (%s): %s mif=%d a=%.2f"
+                    % (type(exc).__name__, ladder, mif, alpha))
+            continue
+        if log:
+            log("  ladder=%s mif=%d a=%.2f -> %d qps"
+                % (list(ladder), mif, alpha, int(total / elapsed)))
+        if best is None or elapsed < best[0]:
+            best = (elapsed, ladder, mif, alpha, stats)
+    if best is None:
+        raise AssertionError("no router candidate passed the gate")
+    elapsed, ladder, mif, alpha, stats = best
+    record = {
+        "knobs": {"buckets": list(ladder), "max_in_flight": mif,
+                  "ewma_alpha": alpha},
+        "measured": {
+            "elapsed_s": round(elapsed, 6),
+            "qps": int(total / elapsed),
+            "trace": trace, "cap": cap, "reps": reps,
+            "candidates_tried": tried, "rejected": rejected,
+            "router_stats": stats,
+        },
+        "fingerprint": device_fingerprint(),
+        "gated": True,  # every routed answer matched the eval_cpu oracle
+    }
+    cache.store(key, record)
+    return {**record, "searched": True}

@@ -110,6 +110,28 @@ def summarize_trace(trace_dir: str, top: int = 12):
                         for k, v in ops]}
 
 
+def quantile(samples, q: float, *, presorted: bool = False) -> float:
+    """Nearest-rank quantile of a sequence of floats (q in [0, 1]).
+
+    Deliberately numpy-free: the latency ring is consulted on the
+    admission-control hot path (every ``submit``), where an np.quantile
+    round-trip would cost more than the dispatch it guards.
+    ``presorted=True`` skips the sort (the ring keeps a cached sorted
+    view for exactly that path)."""
+    if not samples:
+        raise ValueError("quantile of an empty sample set")
+    if not 0.0 <= q <= 1.0:
+        raise ValueError("q must be in [0, 1] (got %r)" % (q,))
+    s = samples if presorted else sorted(samples)
+    return s[min(len(s) - 1, max(0, int(q * len(s) + 0.5) - 1))]
+
+
+#: bounded size of the per-engine latency ring: big enough that p99 over
+#: it is stable, small enough that a long-lived engine's memory and the
+#: per-submit quantile stay O(1)-ish
+LATENCY_RING = 2048
+
+
 @dataclasses.dataclass
 class EngineCounters:
     """Per-engine serving counters (serve/engine.py).
@@ -119,6 +141,15 @@ class EngineCounters:
     synchronous CPU backend) and wait time (host blocking on device
     results) are split so the host/device overlap the engine buys is
     visible in the benchmark record.
+
+    SLO accounting (docs/SERVING.md "Load testing & SLOs"): per-batch
+    submit→result latencies land in a bounded ring (``note_latency``;
+    p50/p95/p99 via ``quantile``), ``deadline_misses`` counts
+    cooperative-deadline trips, and ``shed_*`` count batches/queries the
+    admission control rejected instead of queueing.  ``reset()`` and
+    ``merge()`` let a router (serve/router.py) or ``LookupStream``
+    aggregate per-engine counters into one record without hand-copying
+    fields.
     """
     batches_submitted: int = 0
     queries_submitted: int = 0
@@ -128,11 +159,54 @@ class EngineCounters:
     pack_time_s: float = 0.0
     dispatch_time_s: float = 0.0
     wait_time_s: float = 0.0
+    deadline_misses: int = 0      # cooperative-deadline trips
+    shed_batches: int = 0         # batches rejected by admission control
+    shed_queries: int = 0         # queries inside those batches
+    #: bounded ring of recent per-batch latencies (seconds); leading
+    #: underscore keeps the raw samples out of as_dict — records carry
+    #: the quantiles, not 2048 floats
+    _latencies: list = dataclasses.field(default_factory=list, repr=False)
+    _lat_pos: int = 0
+    #: sorted view of the ring, rebuilt lazily: admission control reads
+    #: p99 on every submit, so the sort must not repeat while no new
+    #: sample landed
+    _lat_sorted: list | None = dataclasses.field(default=None,
+                                                 repr=False)
 
     def note_dispatch(self, padded: int, in_flight: int):
         self.dispatches += 1
         self.padded_queries += padded
         self.in_flight_hwm = max(self.in_flight_hwm, in_flight)
+
+    def note_latency(self, seconds: float):
+        """Record one batch's submit→result latency in the ring
+        (overwriting the oldest sample once ``LATENCY_RING`` is full)."""
+        if len(self._latencies) < LATENCY_RING:
+            self._latencies.append(float(seconds))
+        else:
+            self._latencies[self._lat_pos] = float(seconds)
+            self._lat_pos = (self._lat_pos + 1) % LATENCY_RING
+        self._lat_sorted = None
+
+    def quantile(self, q: float) -> float | None:
+        """Latency quantile over the ring (seconds), None when empty."""
+        if not self._latencies:
+            return None
+        if self._lat_sorted is None:
+            self._lat_sorted = sorted(self._latencies)
+        return quantile(self._lat_sorted, q, presorted=True)
+
+    @property
+    def p50(self):
+        return self.quantile(0.50)
+
+    @property
+    def p95(self):
+        return self.quantile(0.95)
+
+    @property
+    def p99(self):
+        return self.quantile(0.99)
 
     @property
     def pad_waste(self) -> float:
@@ -140,11 +214,53 @@ class EngineCounters:
         total = self.queries_submitted + self.padded_queries
         return self.padded_queries / total if total else 0.0
 
+    def reset(self) -> "EngineCounters":
+        """Zero every counter and drop the latency ring, in place."""
+        for f in dataclasses.fields(self):
+            setattr(self, f.name,
+                    f.default if f.default_factory is dataclasses.MISSING
+                    else f.default_factory())
+        return self
+
+    def merge(self, other: "EngineCounters") -> "EngineCounters":
+        """Fold ``other`` into self: sums for the additive counters, max
+        for the high-water mark, both latency rings pooled.  A pool
+        over the ring bound is DOWNSAMPLED by a uniform stride (not
+        truncated) so every merged engine keeps proportional
+        representation in the aggregate quantiles — a tail slice would
+        silently reduce the aggregate to the last engine merged.
+        Returns self, so ``reduce(EngineCounters.merge, stats_list,
+        EngineCounters())`` builds one aggregate record."""
+        for f in dataclasses.fields(self):
+            if f.name.startswith("_") or f.name == "in_flight_hwm":
+                continue
+            setattr(self, f.name,
+                    getattr(self, f.name) + getattr(other, f.name))
+        self.in_flight_hwm = max(self.in_flight_hwm, other.in_flight_hwm)
+        pooled = self._latencies + other._latencies
+        if len(pooled) > LATENCY_RING:
+            step = len(pooled) / LATENCY_RING
+            pooled = [pooled[int(i * step)] for i in range(LATENCY_RING)]
+        self._latencies = pooled
+        self._lat_pos = 0
+        self._lat_sorted = None
+        return self
+
     def as_dict(self) -> dict:
-        d = dataclasses.asdict(self)
-        for k in ("pack_time_s", "dispatch_time_s", "wait_time_s"):
-            d[k] = round(d[k], 6)
+        d = {}
+        for f in dataclasses.fields(self):
+            if f.name.startswith("_"):
+                continue  # raw latency samples: summarized below
+            v = getattr(self, f.name)
+            d[f.name] = round(v, 6) if isinstance(v, float) else v
         d["pad_waste"] = round(self.pad_waste, 4)
+        if self._latencies:
+            d["latency_ms"] = {
+                "count": len(self._latencies),
+                "p50": round(self.p50 * 1e3, 3),
+                "p95": round(self.p95 * 1e3, 3),
+                "p99": round(self.p99 * 1e3, 3),
+            }
         return d
 
 

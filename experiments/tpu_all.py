@@ -48,7 +48,10 @@ def main():
                          "points (never interrupts a compile)")
     args = ap.parse_args()
     stages = [s.strip() for s in args.stages.split(",") if s.strip()]
-    deadline = time.time() + args.deadline_s
+    # monotonic: this value feeds dispatch_deadline (the cooperative
+    # per-level check compares against time.monotonic() since the NTP
+    # fix) as well as the between-stages check below
+    deadline = time.monotonic() + args.deadline_s
     out = open(args.out, "a", buffering=1)
     # one sid per session process: renderers scope to a single session so
     # retries / older rounds in the append-only file never mix
@@ -73,7 +76,7 @@ def main():
 
     def guard(stage, fn, *a, **kw):
         """Run one measurement point; record errors, keep the session."""
-        if time.time() > deadline:
+        if time.monotonic() > deadline:
             emit(stage, {"skipped": "session deadline"})
             return None
         try:

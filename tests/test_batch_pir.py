@@ -406,6 +406,12 @@ def test_lookup_stream_matches_answer():
     stats = stream.stats()
     assert sum(s["batches_submitted"] for s in stats.values()) == 4 * len(
         stats)
+    # counters(): all group engines merged into ONE EngineCounters —
+    # same totals as summing the per-group dicts by hand
+    agg = stream.counters()
+    assert agg.batches_submitted == 4 * len(stats)
+    assert agg.dispatches == sum(s["dispatches"] for s in stats.values())
+    assert agg.as_dict()["latency_ms"]["count"] > 0
     with pytest.raises(ValueError, match="expected one key per bin"):
         stream.submit(rounds[0][0][:-1])
 

@@ -1,0 +1,90 @@
+"""Open-loop trace generator tests (serve/loadgen.py): determinism
+under a seed, schedule monotonicity, batch-size bounds, the kind
+dispatcher, and the batch-size compatibility view the tuner consumes.
+No JAX involved — these are pure-host checks."""
+
+import pytest
+
+from dpf_tpu.serve import loadgen
+
+
+KIND_KW = {
+    "poisson": dict(rate=25.0, duration_s=3.0, cap=64, seed=3),
+    "bursty": dict(on_rate=30.0, off_rate=1.0, on_s=0.5, off_s=1.0,
+                   duration_s=4.0, cap=64, seed=9),
+    "diurnal": dict(base_rate=3.0, peak_rate=30.0, period_s=2.0,
+                    duration_s=4.0, cap=64, seed=5),
+    "replay": dict(sizes=[1, 64, 7, 32], rate=10.0),
+}
+
+
+@pytest.mark.parametrize("kind", sorted(KIND_KW))
+def test_trace_shape_and_determinism(kind):
+    kw = KIND_KW[kind]
+    tr = loadgen.make_trace(kind, **kw)
+    assert tr, "empty trace"
+    assert tr == loadgen.make_trace(kind, **kw)  # same seed, same trace
+    ts = [a.t for a in tr]
+    assert ts == sorted(ts) and ts[0] >= 0
+    assert all(1 <= a.batch <= 64 for a in tr)
+    if "duration_s" in kw:
+        assert ts[-1] < kw["duration_s"]
+
+
+def test_seed_changes_trace():
+    a = loadgen.poisson_trace(rate=25.0, arrivals=40, cap=64, seed=1)
+    b = loadgen.poisson_trace(rate=25.0, arrivals=40, cap=64, seed=2)
+    assert a != b
+
+
+def test_poisson_exactly_one_stop_rule():
+    with pytest.raises(ValueError):
+        loadgen.poisson_trace(rate=5.0, cap=8)
+    with pytest.raises(ValueError):
+        loadgen.poisson_trace(rate=5.0, duration_s=1.0, arrivals=3, cap=8)
+    tr = loadgen.poisson_trace(rate=5.0, arrivals=7, cap=8)
+    assert len(tr) == 7
+
+
+def test_unknown_kind_raises():
+    with pytest.raises(ValueError, match="unknown trace kind"):
+        loadgen.make_trace("lognormal", cap=8)
+
+
+def test_replay_trace_is_the_size_list_lifted():
+    tr = loadgen.replay_trace([3, 1, 8], rate=4.0)
+    assert [a.batch for a in tr] == [3, 1, 8]
+    assert [a.t for a in tr] == [0.0, 0.25, 0.5]
+    # rate=None: the closed-loop back-to-back replay (legacy tuner)
+    assert all(a.t == 0.0 for a in loadgen.replay_trace([2, 2]))
+
+
+def test_batch_sizes_compat_view():
+    tr = loadgen.replay_trace([5, 9], rate=1.0)
+    assert loadgen.batch_sizes(tr) == [5, 9]
+    assert loadgen.batch_sizes([5, 9]) == [5, 9]  # plain lists pass through
+    assert loadgen.total_queries(tr) == 14
+
+
+def test_bursty_on_windows_are_denser():
+    """Arrivals inside ON windows must dominate — the burst structure
+    is the whole point of the kind (a long OFF gap must not swallow
+    later ON windows, the bug class the per-window clock prevents)."""
+    tr = loadgen.bursty_trace(on_rate=40.0, off_rate=1.0, on_s=1.0,
+                              off_s=2.0, duration_s=9.0, cap=32, seed=7)
+
+    def in_on(t):  # cycle: ON [0,1) OFF [1,3)
+        return t % 3.0 < 1.0
+    on = sum(1 for a in tr if in_on(a.t))
+    assert on >= 0.8 * len(tr)
+    # every ON window (starts at 0, 3, 6) produced arrivals
+    for w in (0.0, 3.0, 6.0):
+        assert any(w <= a.t < w + 1.0 for a in tr), w
+
+
+def test_default_trace_per_kind():
+    for kind in ("poisson", "bursty", "diurnal"):
+        tr = loadgen.default_trace(kind, 32)
+        assert tr and all(1 <= a.batch <= 32 for a in tr)
+    with pytest.raises(ValueError):
+        loadgen.default_trace("replay", 32)

@@ -41,3 +41,104 @@ def test_timer_blocks_on_device():
     with Timer() as t:
         pass
     assert t.elapsed >= 0
+
+
+# ------------------------------------------------------- EngineCounters
+
+def test_quantile_nearest_rank():
+    from dpf_tpu.utils.profiling import quantile
+    xs = [5.0, 1.0, 3.0, 2.0, 4.0]
+    assert quantile(xs, 0.0) == 1.0
+    assert quantile(xs, 0.5) == 3.0
+    assert quantile(xs, 1.0) == 5.0
+    import pytest
+    with pytest.raises(ValueError):
+        quantile([], 0.5)
+    with pytest.raises(ValueError):
+        quantile(xs, 1.5)
+
+
+def test_counters_latency_ring_is_bounded():
+    from dpf_tpu.utils.profiling import LATENCY_RING, EngineCounters
+    c = EngineCounters()
+    assert c.p50 is None and c.quantile(0.99) is None
+    for i in range(LATENCY_RING + 10):
+        c.note_latency(float(i))
+    assert len(c._latencies) == LATENCY_RING
+    # the oldest samples were overwritten, not the newest
+    assert max(c._latencies) == LATENCY_RING + 9
+    assert c.p50 is not None and c.p50 <= c.p95 <= c.p99
+
+
+def test_counters_reset_zeroes_everything():
+    from dpf_tpu.utils.profiling import EngineCounters
+    c = EngineCounters(batches_submitted=3, pack_time_s=1.5,
+                      deadline_misses=2, shed_batches=1)
+    c.note_latency(0.5)
+    c.note_dispatch(padded=4, in_flight=3)
+    c.reset()
+    assert c == EngineCounters()
+    assert c._latencies == [] and c.p50 is None
+
+
+def test_counters_merge_sums_and_pools():
+    from dpf_tpu.utils.profiling import EngineCounters
+    a = EngineCounters(batches_submitted=2, queries_submitted=10,
+                      wait_time_s=0.5, in_flight_hwm=1,
+                      shed_queries=3)
+    a.note_latency(0.1)
+    b = EngineCounters(batches_submitted=4, queries_submitted=7,
+                      wait_time_s=0.25, in_flight_hwm=5,
+                      deadline_misses=1)
+    b.note_latency(0.3)
+    b.note_latency(0.2)
+    out = a.merge(b)
+    assert out is a                       # merges in place, returns self
+    assert a.batches_submitted == 6 and a.queries_submitted == 17
+    assert a.wait_time_s == 0.75 and a.shed_queries == 3
+    assert a.deadline_misses == 1
+    assert a.in_flight_hwm == 5           # max, not sum
+    assert sorted(a._latencies) == [0.1, 0.2, 0.3]  # rings pooled
+    # fold many into one without hand-copying fields
+    from functools import reduce
+    total = reduce(EngineCounters.merge,
+                   [EngineCounters(dispatches=1) for _ in range(3)],
+                   EngineCounters())
+    assert total.dispatches == 3
+
+
+def test_counters_merge_downsamples_full_rings_proportionally():
+    """Merging two FULL rings must keep samples from both (stride
+    downsample), not silently reduce the aggregate quantiles to the
+    last ring merged."""
+    from dpf_tpu.utils.profiling import LATENCY_RING, EngineCounters
+    a, b = EngineCounters(), EngineCounters()
+    for _ in range(LATENCY_RING):
+        a.note_latency(1.0)               # engine A: all 1 s
+        b.note_latency(3.0)               # engine B: all 3 s
+    a.merge(b)
+    assert len(a._latencies) == LATENCY_RING
+    ones = sum(1 for x in a._latencies if x == 1.0)
+    threes = sum(1 for x in a._latencies if x == 3.0)
+    assert ones > 0 and threes > 0        # both engines represented
+    assert abs(ones - threes) <= 2        # ... proportionally
+    assert a.p50 in (1.0, 3.0) and a.quantile(0.25) == 1.0
+
+
+def test_counters_as_dict_rounds_all_floats_generically():
+    import dataclasses
+
+    from dpf_tpu.utils.profiling import EngineCounters
+    c = EngineCounters(pack_time_s=0.12345678901,
+                      dispatch_time_s=1 / 3, wait_time_s=2 / 3)
+    d = c.as_dict()
+    for f in dataclasses.fields(EngineCounters):
+        if f.name.startswith("_"):
+            assert f.name not in d        # raw ring stays out
+            continue
+        assert f.name in d
+        v = d[f.name]
+        if isinstance(v, float):          # every float field rounded
+            assert v == round(v, 6)
+    assert d["pack_time_s"] == 0.123457
+    assert "latency_ms" not in d          # empty ring -> no quantiles

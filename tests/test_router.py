@@ -1,0 +1,288 @@
+"""Scheme-router tests (serve/router.py): routed answers bit-identical
+to the routed construction's blocking loop, cold-cache sticky fallback
+with ``routed_from`` provenance, warm-cache sticky + cost seeding,
+cost-model argmin routing, online EWMA updates, merged counters, the
+admission-control path through the router, and the router-knob tuner
+(``tune.serve_tune.tune_router``) with its cache consumption."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from dpf_tpu import DPF
+from dpf_tpu.serve.engine import LoadShed
+from dpf_tpu.serve.router import LABELS, SchemeRouter
+
+
+N, ENTRY, CAP = 256, 5, 8
+
+
+def _table(n=N, entry=ENTRY, seed=5):
+    return np.random.default_rng(seed).integers(
+        -2 ** 31, 2 ** 31, (n, entry), dtype=np.int64).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def router():
+    # probe-seeded: every (construction, bucket) has a cost estimate,
+    # so routing is cost-model from the first arrival
+    return SchemeRouter(_table(), prf=DPF.PRF_DUMMY, cap=CAP,
+                        buckets=(4, 8), probe=True)
+
+
+def test_probe_seeds_every_construction_and_bucket(router):
+    for lb in LABELS:
+        for bk in (4, 8):
+            assert router.cost(lb, bk) is not None, (lb, bk)
+    assert router.route(CAP).routed_from == "cost-model"
+
+
+def test_routed_answers_match_blocking_loop(router):
+    """Every construction's routed path == its own blocking eval_tpu
+    on the identical keys (and recovery subtracts to the table row)."""
+    tbl = _table()
+    for lb in LABELS:
+        srv = router.server(lb)
+        idxs = [7, 0, N - 1, 100, 3]
+        pairs = [srv.gen(i, N, seed=b"rt-%s-%d" % (lb.encode(), i))
+                 for i in idxs]
+        dec = router.route(len(idxs))
+        # pin the decision to this construction: the test is the data
+        # path, not the policy
+        dec.construction = lb
+        f0 = router.submit(dec, [p[0] for p in pairs])
+        ref = np.asarray(srv.eval_tpu([p[0] for p in pairs]))
+        assert np.array_equal(f0.result(), ref), lb
+        f1 = router.submit(dec, [p[1] for p in pairs])
+        rec = (f0.result() - f1.result()).astype(np.int32)
+        assert (rec == tbl[idxs]).all(), lb
+
+
+def test_cost_model_picks_argmin(router):
+    orig = dict(router._costs)
+    try:
+        for i, lb in enumerate(LABELS):
+            router._costs[(lb, 8)] = 0.010 + i * 0.010
+        router._costs[("radix4", 8)] = 0.001
+        dec = router.route(8)
+        assert dec.construction == "radix4"
+        assert dec.routed_from == "cost-model"
+        assert router.routed_from == "cost-model"
+    finally:
+        router._costs = orig
+
+
+def test_observation_updates_ewma(router):
+    srv = router.server("logn")
+    keys = [srv.gen(i, N, seed=b"ew-%d" % i)[0] for i in range(4)]
+    dec = router.route(4)
+    dec.construction = "logn"
+    before = router.cost("logn", 4)
+    fut = router.submit(dec, keys)
+    fut.result()
+    after = router.cost("logn", 4)
+    assert after is not None and after != before
+    # EWMA: new value is a convex mix, so it stays positive and finite
+    assert 0 < after < 10
+
+
+def test_merged_counters_cover_all_engines(router):
+    agg = router.counters()
+    assert agg.batches_submitted == sum(
+        e.stats.batches_submitted for e in router.engines.values())
+    d = agg.as_dict()
+    assert "latency_ms" in d and d["batches_submitted"] > 0
+
+
+def test_exploration_recovers_poisoned_estimate():
+    """A wildly inflated EWMA entry (client deferred result(), a load
+    transient) must not lock a construction out of the argmin forever:
+    after EXPLORE_EVERY routes at a bucket the stalest construction
+    gets the batch for re-measurement (routed_from='explore')."""
+    r = SchemeRouter(_table(), prf=DPF.PRF_DUMMY, cap=CAP,
+                     buckets=(8,), probe=True)
+    r._costs[("logn", 8)] = 99.0          # poisoned: never the argmin
+    seen = set()
+    for _ in range(r.EXPLORE_EVERY + 1):
+        d = r.route(8)
+        seen.add((d.construction, d.routed_from))
+    assert ("logn", "explore") in seen    # re-measured despite the cost
+    assert any(f == "cost-model" for _, f in seen)
+    # an actual explore dispatch corrects the estimate
+    srv = r.server("logn")
+    keys = [srv.gen(i, N, seed=b"xp-%d" % i)[0] for i in range(8)]
+    from dpf_tpu.serve.router import RouteDecision
+    dec = RouteDecision("logn", "explore", 8, 8)
+    r.submit(dec, keys).result()
+    assert r.cost("logn", 8) < 99.0
+
+
+def test_cold_cache_falls_back_to_sticky_heuristic():
+    r = SchemeRouter(_table(), prf=DPF.PRF_DUMMY, cap=CAP,
+                     buckets=(8,), probe=False, warmup=False)
+    dec = r.route(5)
+    assert dec.construction == r.sticky == "logn"
+    assert dec.routed_from == "heuristic"
+    assert r.routed_from == "heuristic"       # mirrors the resolution
+    assert r.stats()["routed_from_counts"] == {"heuristic": 1}
+
+
+def test_warm_scheme_cache_seeds_sticky_and_costs(monkeypatch, tmp_path):
+    """A scheme-sweep winner in the tuning cache makes the sticky
+    fallback 'cache' and seeds the cost model with the sweep's
+    per-construction measured seconds at the cap bucket."""
+    from dpf_tpu.tune.cache import TuningCache
+    from dpf_tpu.tune.search import scheme_cache_key
+    path = str(tmp_path / "tuning.json")
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", path)
+    cache = TuningCache(path)
+    cache.store(
+        scheme_cache_key(n=N, entry_size=ENTRY, batch=8, prf_method=0),
+        {"knobs": {"scheme": "sqrtn", "radix": 2,
+                   "construction": "sqrtn"},
+         "measured": {"per_construction": [
+             {"construction": "logn", "tuned_s": 0.004},
+             {"construction": "radix4", "tuned_s": 0.003},
+             {"construction": "sqrtn", "tuned_s": 0.001}]},
+         "gated": True})
+    r = SchemeRouter(_table(), prf=DPF.PRF_DUMMY, cap=CAP,
+                     buckets=(8,), probe=False, warmup=False)
+    assert (r.sticky, r.sticky_resolved_from) == ("sqrtn", "cache")
+    dec = r.route(3)
+    # cap-bucket costs seeded for all three -> cost-model immediately
+    assert dec.routed_from == "cost-model"
+    assert dec.construction == "sqrtn"
+    assert r.cost("radix4", 8) == pytest.approx(0.003)
+    # nearest-batch: a router at a DIFFERENT cap still resolves the
+    # sticky winner from the cache (mirroring DPF._ensure_scheme) but
+    # does NOT take the other batch's magnitudes as cost seeds
+    r2 = SchemeRouter(_table(), prf=DPF.PRF_DUMMY, cap=4,
+                      buckets=(4,), probe=False, warmup=False)
+    assert (r2.sticky, r2.sticky_resolved_from) == ("sqrtn", "cache")
+    assert r2.cost("radix4", 4) is None
+    assert r2.route(3).routed_from == "cache"
+
+
+def test_router_shed_path():
+    r = SchemeRouter(_table(), prf=DPF.PRF_DUMMY, cap=CAP,
+                     buckets=(8,), probe=False, warmup=False,
+                     max_queue_depth=1, shed=True)
+    srv = r.server(r.sticky)
+    keys = [srv.gen(i, N, seed=b"sh-%d" % i)[0] for i in range(8)]
+    dec = r.route(8)
+    f1 = r.submit(dec, keys)
+    with pytest.raises(LoadShed):
+        r.submit(r.route(8), keys)
+    agg = r.counters()
+    assert agg.shed_batches == 1 and agg.shed_queries == 8
+    f1.result()                        # engine still consistent
+    r.drain()
+
+
+def test_reset_counters_keeps_learned_state(router):
+    router.route(4)
+    assert sum(router.route_counts.values()) > 0
+    costs = dict(router._costs)
+    router.reset_counters()
+    assert sum(router.route_counts.values()) == 0
+    assert router.counters().batches_submitted == 0
+    assert router._costs == costs      # the cost model survives
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="unknown construction"):
+        SchemeRouter(_table(), constructions=("logn", "r5"))
+    with pytest.raises(ValueError, match="ewma_alpha"):
+        SchemeRouter(_table(), ewma_alpha=0.0)
+    with pytest.raises(ValueError, match="at least one"):
+        SchemeRouter(_table(), constructions=())
+
+
+# ------------------------------------------------------- tune_router
+
+
+def test_tune_router_and_consumption(monkeypatch, tmp_path):
+    from dpf_tpu.tune.serve_tune import lookup_router_knobs, tune_router
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE",
+                       str(tmp_path / "tuning.json"))
+    tbl = _table()
+    rec = tune_router(tbl, prf_method=0, cap=CAP, trace=[8, 3, 8, 1],
+                      ladders=[(8,), (4, 8)], in_flight=(1,), reps=1)
+    assert rec["searched"] and rec["gated"]
+    assert rec["measured"]["rejected"] == 0
+    assert rec["measured"]["candidates_tried"] == 2
+    # warm cache: second call does not search
+    rec2 = tune_router(tbl, prf_method=0, cap=CAP)
+    assert not rec2["searched"]
+    # the persisted record round-trips through JSON (CI artifact shape)
+    json.dumps(rec2["measured"])
+    # consumption: a router built with buckets=None adopts the winner
+    r = SchemeRouter(tbl, prf=DPF.PRF_DUMMY, cap=CAP, probe=False,
+                     warmup=False)
+    assert list(r.buckets.sizes) == rec["knobs"]["buckets"]
+    knobs = lookup_router_knobs(r, CAP)
+    assert knobs == rec["knobs"]
+
+
+def test_tune_router_rejects_trace_over_cap(tmp_path, monkeypatch):
+    from dpf_tpu.tune.serve_tune import tune_router
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE",
+                       str(tmp_path / "tuning.json"))
+    with pytest.raises(ValueError, match="exceeds cap"):
+        tune_router(_table(), cap=8, trace=[16])
+
+
+# ---------------------------------------------- open-loop replay harness
+
+
+def test_replay_open_loop_accounting():
+    """The load harness's replay loop, on a fake server: latencies are
+    completion - SCHEDULED arrival, sheds are excluded from the served
+    set, and every non-shed arrival resolves exactly once."""
+    from dpf_tpu.serve.bench_load import _slo_stats, replay
+    from dpf_tpu.serve.loadgen import Arrival
+
+    class FakeFut:
+        def __init__(self, j):
+            self.j = j
+
+        def result(self):
+            return self.j
+
+    trace = [Arrival(0.0, None, 4), Arrival(0.01, None, 2),
+             Arrival(0.02, None, 8)]
+    calls = []
+
+    def submit(a, j):
+        if j == 1:
+            raise LoadShed("full")
+        calls.append(j)
+        return FakeFut(j)
+
+    lats, done, makespan, sheds, shed_q = replay(trace, submit, window=2)
+    assert calls == [0, 2] and sheds == 1 and shed_q == 2
+    assert len(lats) == len(done) == 2
+    assert all(x >= 0 for x in lats) and makespan >= 0.02
+    s = _slo_stats(lats, slo_s=10.0)
+    assert s["deadline_miss_batches"] == 0
+    assert s["p50_ms"] <= s["p95_ms"] <= s["p99_ms"] <= s["max_ms"]
+
+
+@pytest.mark.skipif(
+    not os.environ.get("DPF_RUN_SLOW"),
+    reason="full --load dryrun (three servers + probe + three legs) "
+           "runs in the DPF_RUN_SLOW lane; the replay harness and "
+           "router are covered piecewise in tier-1")
+def test_load_bench_dryrun_record():
+    from dpf_tpu.serve.bench_load import load_bench
+    rec = load_bench(n=512, entry_size=8, cap=16, prf=0, seed=11,
+                     duration_s=1.0, on_rate=25.0, reps=1, distinct=8,
+                     quiet=True)
+    assert rec["gate_rejections"] == 0 and rec["checked"]
+    for leg in ("sticky", "router"):
+        for k in ("qps", "p50_ms", "p99_ms", "deadline_miss_batches"):
+            assert k in rec[leg], (leg, k)
+    assert "shed_batches" in rec["shed_leg"]
+    json.dumps(rec)                     # record is committable JSON

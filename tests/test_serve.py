@@ -181,14 +181,142 @@ def test_failed_mid_submit_leaves_engine_consistent():
 
 
 def test_engine_deadline_is_cooperative():
+    """The deadline is a time.monotonic() value (NTP-step immune) and
+    can also be given relatively via the ``timeout_s`` ctor arg."""
     dpf, keys = _setup()
     engine = dpf.serving_engine(buckets=(4,))
-    engine.deadline = time.time() - 1
+    engine.deadline = time.monotonic() - 1
     with pytest.raises(DeadlineExceeded):
         engine.submit(_batches(keys, [4])[0])
+    assert engine.stats.deadline_misses == 1
     engine.deadline = None
     fut = engine.submit(_batches(keys, [4])[0])
     assert fut.result().shape == (4, 7)
+    # relative spelling: timeout_s computes the monotonic deadline
+    expired = dpf.serving_engine(buckets=(4,), timeout_s=-1.0)
+    with pytest.raises(DeadlineExceeded):
+        expired.submit(_batches(keys, [4])[0])
+    alive = dpf.serving_engine(buckets=(4,), timeout_s=3600.0)
+    assert alive.submit(_batches(keys, [4])[0]).result().shape == (4, 7)
+    with pytest.raises(ValueError, match="not both"):
+        dpf.serving_engine(buckets=(4,), deadline=time.monotonic() + 1,
+                           timeout_s=1.0)
+
+
+def _trip_after_first_dispatch(dpf, engine):
+    """Arm the deadline so it passes DURING the first chunk's dispatch:
+    the submit's next cooperative check trips mid-batch."""
+    real_dispatch = dpf._dispatch_packed
+
+    def slow(pk):
+        out = real_dispatch(pk)
+        engine.deadline = time.monotonic() - 1   # passes "during" it
+        return out
+
+    dpf._dispatch_packed = slow
+    return real_dispatch
+
+
+def test_deadline_mid_batch_unwinds_partial_submit():
+    """A deadline tripping between the chunks of a multi-chunk submit
+    must leave the window and pending queue empty with consistent
+    counters — a router shedding one group keeps serving the next."""
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,), max_in_flight=8)
+    real = _trip_after_first_dispatch(dpf, engine)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            engine.submit([keys[i % len(keys)] for i in range(8)])
+    finally:
+        dpf._dispatch_packed = real
+    assert engine.in_flight == 0 and not engine._queue
+    assert not engine._pending
+    assert engine.stats.deadline_misses == 1
+    assert engine.stats.batches_submitted == 0
+    assert engine.stats.queries_submitted == 0
+    assert engine.stats.dispatches == 1      # the first chunk ran
+    engine.deadline = None
+    batch = _batches(keys, [4])[0]
+    assert np.array_equal(engine.submit(batch).result(),
+                          np.asarray(dpf.eval_tpu(batch)))
+
+
+def test_deadline_between_dispatches_max_in_flight_1():
+    """With a window of 1 the second chunk waits in the backpressure
+    loop — the deadline check THERE must unwind, not hang or orphan."""
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,), max_in_flight=1)
+    real = _trip_after_first_dispatch(dpf, engine)
+    try:
+        with pytest.raises(DeadlineExceeded):
+            engine.submit([keys[i % len(keys)] for i in range(8)])
+    finally:
+        dpf._dispatch_packed = real
+    assert engine.in_flight == 0 and not engine._pending
+    assert engine.stats.batches_submitted == 0
+    engine.deadline = None
+    batch = _batches(keys, [4])[0]
+    assert np.array_equal(engine.submit(batch).result(),
+                          np.asarray(dpf.eval_tpu(batch)))
+
+
+# -------------------------------------------------- admission + latency
+
+def test_shed_on_queue_depth():
+    from dpf_tpu.serve import LoadShed
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,), max_in_flight=8,
+                                max_queue_depth=1, shed=True)
+    f1 = engine.submit(_batches(keys, [4])[0])
+    with pytest.raises(LoadShed):
+        engine.submit(_batches(keys, [4])[0])
+    assert engine.stats.shed_batches == 1
+    assert engine.stats.shed_queries == 4
+    assert np.array_equal(f1.result(),
+                          np.asarray(dpf.eval_tpu(_batches(keys,
+                                                           [4])[0])))
+    # queue drained: admitted again
+    assert engine.submit(_batches(keys, [4])[0]).result() is not None
+
+
+def test_queue_depth_blocks_without_shed():
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,), max_in_flight=8,
+                                max_queue_depth=2)
+    futs = [engine.submit(_batches(keys, [4])[0]) for _ in range(5)]
+    assert len(engine._pending) <= 2     # submit resolved the overflow
+    engine.drain()
+    assert all(f.done() for f in futs)
+    assert engine.stats.shed_batches == 0
+
+
+def test_shed_on_p99_over_slo_requires_backlog():
+    from dpf_tpu.serve import LoadShed
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,), max_in_flight=8,
+                                slo_s=1e-9, shed=True)
+    # idle engine admits even with a terrible p99 estimate
+    engine.stats.note_latency(1.0)
+    f1 = engine.submit(_batches(keys, [4])[0])
+    # now a backlog exists -> the p99-over-SLO trigger sheds
+    with pytest.raises(LoadShed):
+        engine.submit(_batches(keys, [4])[0])
+    f1.result()
+    engine.drain()
+    # backlog drained -> admitted again (shedding self-heals)
+    assert engine.submit(_batches(keys, [4])[0]).result() is not None
+
+
+def test_latency_ring_feeds_quantiles():
+    dpf, keys = _setup()
+    engine = dpf.serving_engine(buckets=(4,))
+    for _ in range(5):
+        engine.submit(_batches(keys, [4])[0]).result()
+    assert engine.stats.p50 is not None
+    assert engine.stats.p50 <= engine.stats.p99
+    d = engine.stats.as_dict()
+    assert d["latency_ms"]["count"] == 5
+    assert d["latency_ms"]["p50"] <= d["latency_ms"]["p99"]
 
 
 # --------------------------------------------------------- stats + warmup

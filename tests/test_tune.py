@@ -349,6 +349,34 @@ def test_serving_warmup_tune_in_place(tmp_path, monkeypatch):
     assert np.array_equal(fut.result(), np.asarray(dpf.eval_tpu(ks)))
 
 
+def test_tune_serving_accepts_loadgen_traces(tmp_path, monkeypatch):
+    """The serving-knob tuner replays loadgen traces (Arrival lists or
+    a trace_kind string) — synthetic_trace stays the default when
+    neither is given; trace and trace_kind are mutually exclusive."""
+    from dpf_tpu.serve import loadgen
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    c = tcache.default_cache(refresh=True)
+    n = 256
+    dpf = dpf_tpu.DPF(prf=0)
+    table = np.random.default_rng(7).integers(
+        0, 2 ** 31, (n, 16), dtype=np.int32, endpoint=False)
+    dpf.eval_init(table)
+    trace = loadgen.replay_trace([8, 3, 8, 1], rate=100.0)
+    rec = serve_tune.tune_serving(dpf, cap=8, trace=trace,
+                                  ladders=[(8,), (4, 8)],
+                                  in_flight=(1,), reps=1, cache=c)
+    assert rec["searched"] and rec["gated"]
+    # the record stores the batch-size view of the Arrival trace
+    assert rec["measured"]["trace"] == [8, 3, 8, 1]
+    with pytest.raises(ValueError, match="not both"):
+        serve_tune.tune_serving(dpf, cap=8, trace=[4],
+                                trace_kind="bursty", force=True)
+    # resolve_trace: kind -> the canonical default, None -> legacy
+    sizes = serve_tune.resolve_trace(8, trace_kind="bursty")
+    assert sizes and all(1 <= b <= 8 for b in sizes)
+    assert serve_tune.resolve_trace(8) == serve_tune.synthetic_trace(8)
+
+
 def test_compcache_adopts_preconfigured_dir(tmp_path, monkeypatch):
     """enable() must never clobber a compilation-cache dir the process
     configured itself (relay scripts set their own dir + floors)."""
