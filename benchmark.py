@@ -11,6 +11,7 @@
 #   --batch-pir        serve/bench_pir.py           BENCH_PIR_r09.json
 #   --multichip        serve/bench_multichip.py     MULTICHIP_r06.json
 #   --load             serve/bench_load.py          BENCH_LOAD_r10.json
+#   --chaos            serve/bench_chaos.py         BENCH_CHAOS_r11.json
 #
 # --serve: streaming serving benchmark (blocking loop vs pipelined
 # ServingEngine).  See docs/SERVING.md.
@@ -40,6 +41,14 @@
 # bursty trace, with p50/p99 + deadline-miss/shed SLO accounting and
 # every served batch gated against the scalar oracle; --dryrun is the
 # seconds-long CI smoke.  See docs/SERVING.md "Load testing & SLOs".
+#
+# --chaos: fault-tolerant serving — the same seeded bursty trace
+# replayed under escalating fault plans (injected dispatch failures,
+# stragglers, corrupted shares, a full engine death), reporting
+# availability (correct-within-SLO), retries, failovers, breaker
+# transitions and engine restarts, every served batch still gated;
+# --dryrun is the seconds-long CI smoke.  See docs/SERVING.md "Fault
+# tolerance & chaos testing".
 
 import sys
 
@@ -115,6 +124,10 @@ if __name__ == "__main__":
     if "--load" in sys.argv:
         from dpf_tpu.serve.bench_load import main
         main([a for a in sys.argv[1:] if a != "--load"])
+        sys.exit(0)
+    if "--chaos" in sys.argv:
+        from dpf_tpu.serve.bench_chaos import main
+        main([a for a in sys.argv[1:] if a != "--chaos"])
         sys.exit(0)
     if "--autotune-scheme" in sys.argv:
         _autotune_scheme_main(

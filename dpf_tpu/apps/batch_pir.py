@@ -663,14 +663,17 @@ class PrivateLookupServer:
 
     # ------------------------------------------------------- streaming
 
-    def stream(self, *, max_in_flight: int = 2, warmup: bool = True):
+    def stream(self, *, max_in_flight: int = 2, warmup: bool = True,
+               retry=None):
         """A ``LookupStream`` serving multi-round query batches through
         one ``ServingEngine`` per (n, G) size group — vectorized ingest,
         precompiled fixed shapes (shape buckets keyed on the group), and
-        an in-flight dispatch window per group.  See docs/BATCH_PIR.md.
+        an in-flight dispatch window per group.  ``retry`` (a
+        ``serve.RetryPolicy``) re-attempts failed group dispatches —
+        see docs/BATCH_PIR.md and docs/SERVING.md "Fault tolerance".
         """
         return LookupStream(self, max_in_flight=max_in_flight,
-                            warmup=warmup)
+                            warmup=warmup, retry=retry)
 
 
 class _GroupStreamServer:
@@ -747,21 +750,30 @@ class LookupStream:
     execution (on a synchronous backend the win is the ingest + shape
     reuse).  ``submit`` returns a ``LookupRoundFuture`` immediately;
     results are bit-identical to ``PrivateLookupServer.answer``.
+
+    ``retry`` (a ``serve.RetryPolicy``) re-attempts a failed group
+    dispatch under bounded backoff — ``ServingEngine.submit``'s
+    partial-unwind keeps the engine consistent between attempts, and
+    re-attempts count into that engine's ``stats.retries`` (visible in
+    ``counters()``).  ``LoadShed``/deadline still propagate
+    immediately (admission decisions are never retried).
     """
 
     def __init__(self, server: PrivateLookupServer, *,
-                 max_in_flight: int = 2, warmup: bool = True):
+                 max_in_flight: int = 2, warmup: bool = True,
+                 retry=None):
         from ..core.u128 import next_pow2
         from ..serve import ServingEngine
         self._server = server
         self._n_bins = len(server.bins)
+        self._retry = retry
         self._engines = []              # [(n, group, engine)]
         for n, grp in server._groups.items():
             bucket = next_pow2(len(grp.idxs) + grp.gpad)
             adapter = _GroupStreamServer(server, n, grp)
             self._engines.append((n, grp, ServingEngine(
                 adapter, max_in_flight=max_in_flight, buckets=[bucket],
-                warmup=warmup)))
+                warmup=warmup, label="n%dxG%d" % (n, len(grp.idxs)))))
 
     def submit(self, keys_per_bin) -> LookupRoundFuture:
         """Decode + dispatch one query round (one key per bin); returns
@@ -779,7 +791,13 @@ class LookupStream:
             (grp, eng, self._server._decode_group(
                 n, grp, [keys_per_bin[bi] for bi in grp.idxs]))
             for n, grp, eng in self._engines]
-        parts = [(grp, eng.submit(pk)) for grp, eng, pk in decoded]
+        if self._retry is None:
+            parts = [(grp, eng.submit(pk)) for grp, eng, pk in decoded]
+        else:
+            from ..serve.faults import submit_with_retry
+            parts = [(grp, submit_with_retry(
+                lambda eng=eng, pk=pk: eng.submit(pk), self._retry,
+                stats=eng.stats)) for grp, eng, pk in decoded]
         return LookupRoundFuture(self._n_bins, self._server.entry_size,
                                  parts)
 

@@ -20,6 +20,7 @@ from __future__ import annotations
 import numpy as np
 
 _initialized = False  # explicit module state: initialize() succeeded here
+_init_error: str | None = None  # why the last silent fallback happened
 
 
 def is_initialized() -> bool:
@@ -42,28 +43,37 @@ def initialize(coordinator_address: str | None = None,
     With explicit arguments, failures propagate.  With no arguments,
     initialization is attempted unconditionally — on TPU pod slices JAX's
     cluster auto-detection supplies everything — and a detection failure
-    (plain single-process run, tests) degrades to a no-op returning False.
+    (plain single-process run, tests) degrades to a no-op returning False
+    with the cause recorded (``process_info().init_error`` /
+    ``init_error()``) so a half-formed cluster is visible.
     """
-    global _initialized
+    global _initialized, _init_error
     import jax
     if is_initialized():
+        _init_error = None
         return True
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
                                    process_id=process_id)
         _initialized = True
+        _init_error = None
         return True
     except Exception as e:
         # belt-and-braces for external initialization on JAX versions
         # where the private-state probe in is_initialized() is stale
         if "already initialized" in str(e).lower():
             _initialized = True
+            _init_error = None
             return True
         if (coordinator_address is not None or num_processes is not None
                 or process_id is not None or _cluster_expected()):
             raise  # a real cluster failed to initialize: surface it
-        return False  # no cluster detected: single-process run
+        # no cluster detected: single-process run — but keep the cause:
+        # on a real pod a mis-set env var lands here and the only
+        # symptom is process_count()==1
+        _init_error = "%s: %s" % (type(e).__name__, e)
+        return False
 
 
 def _cluster_expected() -> bool:
@@ -90,7 +100,38 @@ def global_mesh(n_batch: int = 1, n_table: int | None = None):
                              devices=devices)
 
 
-def process_info():
-    """(process_index, process_count) — for logging/sharded IO."""
+class ProcessInfo(tuple):
+    """(process_index, process_count) that also carries why a silent
+    ``initialize()`` fallback happened: ``init_error`` is the recorded
+    failure cause (None when init succeeded or was never attempted).
+    A plain 2-tuple to existing callers — ``pi, pc = process_info()``
+    keeps working."""
+    init_error: str | None
+
+    def __new__(cls, index, count, init_error=None):
+        self = super().__new__(cls, (index, count))
+        self.init_error = init_error
+        return self
+
+    @property
+    def index(self):
+        return self[0]
+
+    @property
+    def count(self):
+        return self[1]
+
+
+def init_error() -> str | None:
+    """The recorded cause of the last silent ``initialize()`` fallback
+    (None = initialized, or never attempted)."""
+    return _init_error
+
+
+def process_info() -> ProcessInfo:
+    """(process_index, process_count) — for logging/sharded IO; carries
+    ``init_error`` so a half-formed cluster (initialize fell back to
+    single-process) is visible where the process count is read."""
     import jax
-    return jax.process_index(), jax.process_count()
+    return ProcessInfo(jax.process_index(), jax.process_count(),
+                       _init_error)

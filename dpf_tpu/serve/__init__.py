@@ -7,14 +7,22 @@ deadlines, a latency ring, and admission control (``LoadShed``);
 batch sizes; ``loadgen.py`` generates deterministic open-loop arrival
 traces (Poisson / bursty / diurnal / replay); ``SchemeRouter``
 (router.py) dispatches each arriving batch to the cheapest construction
-by a live cost model; ``bench_serve.py`` measures sustained queries/sec
-for the blocking loop vs. the engine and ``bench_load.py`` races the
+by a live cost model; ``faults.py`` supplies seeded fault injection
+(``FaultPlan``/``FaultInjector``) and the recovery machinery
+(``RetryPolicy``, ``CircuitBreaker``, ``EngineSupervisor``) the router
+wires together; ``bench_serve.py`` measures sustained queries/sec
+for the blocking loop vs. the engine, ``bench_load.py`` races the
 router against the sticky baseline under a traffic trace with SLO
-accounting.  Constructed via ``DPF.serving_engine()`` or
-``ShardedDPFServer.serving_engine()``.
+accounting, and ``bench_chaos.py`` replays that trace under escalating
+fault plans to measure availability.  Constructed via
+``DPF.serving_engine()`` or ``ShardedDPFServer.serving_engine()``.
 """
 
 from .buckets import Buckets  # noqa: F401
 from .engine import EngineFuture, LoadShed, ServingEngine  # noqa: F401
+from .faults import (CircuitBreaker, EngineDead, EngineSupervisor,  # noqa: F401
+                     FaultError, FaultInjector, FaultPlan, FaultSpec,
+                     InjectedCompileError, InjectedDispatchError,
+                     RetryPolicy, submit_with_retry)
 from .loadgen import Arrival, make_trace  # noqa: F401
 from .router import RouteDecision, SchemeRouter  # noqa: F401

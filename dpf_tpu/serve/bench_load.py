@@ -292,7 +292,7 @@ def _shed_leg(servers, cap, trace, pools, slo_s, window) -> dict:
     router = SchemeRouter(None, servers=servers, cap=cap, probe=True,
                           slo_s=slo_s, max_queue_depth=max(2, window // 2),
                           shed=True)
-    squeezed = [loadgen.Arrival(a.t / 4.0, a.n, a.batch) for a in trace]
+    squeezed = loadgen.squeeze(trace, 4.0)
 
     def submit(a, j):
         dec = router.route(a.batch)

@@ -38,7 +38,7 @@ from collections import deque
 import numpy as np
 
 from ..core.expand import DeadlineExceeded
-from ..utils.profiling import EngineCounters
+from ..utils.profiling import EngineCounters, note_swallowed
 from .buckets import Buckets
 
 
@@ -55,11 +55,12 @@ class LoadShed(RuntimeError):
 
 class _Part:
     """One dispatched (bucket-padded) chunk of a submitted batch."""
-    __slots__ = ("dev", "n_real", "out")
+    __slots__ = ("dev", "n_real", "bucket", "out")
 
-    def __init__(self, dev, n_real):
+    def __init__(self, dev, n_real, bucket):
         self.dev = dev          # device array, possibly still in flight
         self.n_real = n_real    # rows that are real queries (not pad)
+        self.bucket = bucket    # padded dispatch size (fault targeting)
         self.out = None         # resolved host array
 
 
@@ -113,6 +114,12 @@ class ServingEngine:
       shed: reject (raise ``LoadShed``, counted in
         ``stats.shed_batches/shed_queries``) instead of blocking when
         admission control trips.
+      label: construction label for fault targeting and router
+        bookkeeping (``serve/faults.py``); None outside a router.
+      injector: a ``faults.FaultInjector`` consulted at the first-class
+        injection points (before each dispatch, on each resolved
+        result, before each warmup precompile).  None = no injection —
+        the points cost one attribute check on the hot path.
 
     ``deadline`` (a ``time.monotonic()`` value — immune to NTP steps;
     pass ``timeout_s`` to have the engine compute it) is checked
@@ -125,7 +132,8 @@ class ServingEngine:
                  warmup: bool = False, deadline: float | None = None,
                  timeout_s: float | None = None,
                  max_queue_depth: int | None = None,
-                 slo_s: float | None = None, shed: bool = False):
+                 slo_s: float | None = None, shed: bool = False,
+                 label: str | None = None, injector=None):
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1 (got %d)"
                              % max_in_flight)
@@ -159,6 +167,8 @@ class ServingEngine:
         self.max_queue_depth = max_queue_depth
         self.slo_s = slo_s
         self.shed = bool(shed)
+        self.label = label
+        self._injector = injector
         self.stats = EngineCounters()
         self._queue = deque()     # _Part refs, dispatch order, unresolved
         self._pending = deque()   # futures with unresolved parts, FIFO
@@ -169,8 +179,9 @@ class ServingEngine:
         try:
             from ..tune import compcache
             compcache.enable()
-        except Exception:  # cache must never break serving
-            pass
+        except Exception as e:  # cache must never break serving —
+            # but the cause stays diagnosable (counter + one-shot warn)
+            note_swallowed("serve.engine.compcache_enable", e, self.stats)
         if warmup:
             self.warmup()
 
@@ -209,10 +220,15 @@ class ServingEngine:
                 while len(self._queue) >= self.max_in_flight:
                     self._check_deadline()
                     self._resolve_one()
+                if self._injector is not None:
+                    # first-class injection point: may sleep (straggler),
+                    # raise InjectedDispatchError, or raise EngineDead —
+                    # the partial-unwind below handles either
+                    self._injector.on_dispatch(self, size)
                 t1 = time.perf_counter()
                 dev = self._server._dispatch_packed(padded)
                 self.stats.dispatch_time_s += time.perf_counter() - t1
-                part = _Part(dev, hi - lo)
+                part = _Part(dev, hi - lo, size)
                 fut._parts.append(part)
                 self._queue.append(part)
                 self.stats.note_dispatch(padded=size - (hi - lo),
@@ -244,6 +260,12 @@ class ServingEngine:
         part = self._queue.popleft()
         t0 = time.perf_counter()
         part.out = np.asarray(part.dev)[:part.n_real]
+        if self._injector is not None:
+            # injection point: corrupted-share faults replace the rows
+            # here, downstream of the device — the bit-gating oracle
+            # path must catch every one (integrity-check role)
+            part.out = self._injector.on_result(self, part.bucket,
+                                                part.out)
         self.stats.wait_time_s += time.perf_counter() - t0
         part.dev = None
 
@@ -314,6 +336,11 @@ class ServingEngine:
                 self.buckets = Buckets(knobs["buckets"])
                 self.max_in_flight = int(knobs["max_in_flight"])
         for size in self.buckets.sizes:
+            if self._injector is not None:
+                # injection point: compile failures fire here (and a
+                # dead engine's warmup stays dead) — a supervisor
+                # rebuild's re-warm exercises exactly this path
+                self._injector.on_warmup(self, size)
             np.asarray(self._server._dispatch_packed(
                 self._synthetic_packed(size)))
 
@@ -349,6 +376,11 @@ class ServingEngine:
         """
         out = {}
         for size in self.buckets.sizes:
+            if self._injector is not None:
+                # a dead engine must fail its probe: the breaker's
+                # half-open re-probe relies on this to stay open until
+                # the supervisor's rebuilt engine is actually serving
+                self._injector.on_warmup(self, size)
             pk = self._synthetic_packed(size)
             np.asarray(self._server._dispatch_packed(pk))
             best = float("inf")
@@ -373,8 +405,10 @@ class ServingEngine:
         if callable(rk):
             try:
                 d.update(rk(self.buckets.max))
-            except Exception:  # diagnostics must never break serving
-                pass
+            except Exception as e:  # diagnostics must never break
+                # serving — but the cause stays diagnosable
+                note_swallowed("serve.engine.resolved_config", e,
+                               self.stats)
         return d
 
     def _check_deadline(self):

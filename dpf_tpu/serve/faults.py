@@ -1,0 +1,466 @@
+"""Seeded fault injection + the recovery machinery it exercises.
+
+The serving stack's redundancy substrate is the multi-construction
+router (serve/router.py): three independent ways to answer the same
+query over the same table mean a failing construction is a *routing*
+problem, not a new code path (the Chameleon scheme-switching move,
+PAPERS.md arXiv:2410.05934, read as a failover mechanism).  This module
+supplies both sides of the failure story:
+
+**Injection** — ``FaultPlan`` (a list of ``FaultSpec``) + seed compiles
+into a ``FaultInjector`` consulted at first-class injection points in
+``ServingEngine.submit``/``_resolve_one``/``warmup`` and (via the
+engines) ``SchemeRouter.submit``.  Five fault kinds, each targetable by
+construction x bucket x arrival-index window with a per-consult
+probability:
+
+* ``dispatch_error``  — the dispatch raises (a flaky device/runtime),
+* ``compile_error``   — warmup/rebuild precompile raises,
+* ``latency``         — a straggler: the dispatch sleeps ``latency_s``,
+* ``corrupt_shares``  — the resolved result rows are bit-flipped (the
+  existing bit-gating oracle path must catch every one — the gate
+  doubles as an integrity check),
+* ``engine_death``    — the CURRENT engine object is poisoned: every
+  subsequent dispatch/warmup on it raises ``EngineDead`` until the
+  supervisor rebuilds a fresh engine over the same prepared server.
+
+Decisions are **deterministic under the plan seed**: each consult draws
+from ``np.random.default_rng((seed, spec_index, arrival, consult))``,
+a pure function of the targeting coordinates — the same plan replayed
+over the same trace injects the identical faults (per-spec consult
+order; single-threaded replay is exactly reproducible).
+
+**Recovery** — ``RetryPolicy`` (bounded attempts, exponential backoff
+with seeded jitter; ``submit_with_retry`` applies it at batch
+granularity, reusing ``ServingEngine.submit``'s partial-unwind so a
+retried engine is always consistent), ``CircuitBreaker`` (K consecutive
+failures -> open; half-open re-probe after ``reset_s``), and
+``EngineSupervisor`` (rebuilds a dead engine over the same prepared
+server and re-warms it, in the background by default, while the router
+serves degraded).  The router wires them together; the chaos bench
+(``serve/bench_chaos.py``, ``benchmark.py --chaos``) replays escalating
+plans and commits the availability record.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from ..core.expand import DeadlineExceeded
+from .engine import LoadShed, ServingEngine
+
+#: fault kinds a FaultSpec can name
+KINDS = ("dispatch_error", "compile_error", "latency", "corrupt_shares",
+         "engine_death")
+
+
+class FaultError(RuntimeError):
+    """Base class of every injected fault (so harnesses can tell an
+    injected failure from a genuine one)."""
+
+
+class InjectedDispatchError(FaultError):
+    """An injected per-dispatch failure (``kind="dispatch_error"``)."""
+
+
+class InjectedCompileError(FaultError):
+    """An injected warmup/precompile failure (``kind="compile_error"``)."""
+
+
+class EngineDead(FaultError):
+    """The engine object is poisoned (``kind="engine_death"``): every
+    dispatch raises until the supervisor rebuilds a fresh engine."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One targeted fault stream.
+
+    ``construction``/``bucket`` of None match anything; ``start``/
+    ``stop`` bound the arrival-index window (stop exclusive, None =
+    open-ended); ``p`` is the per-consult firing probability;
+    ``max_fires`` bounds total fires (``engine_death`` is implicitly
+    once).  ``latency_s`` only applies to ``kind="latency"``."""
+    kind: str
+    construction: str | None = None
+    bucket: int | None = None
+    start: int = 0
+    stop: int | None = None
+    p: float = 1.0
+    latency_s: float = 0.05
+    max_fires: int | None = None
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError("unknown fault kind %r (one of %s)"
+                             % (self.kind, ", ".join(KINDS)))
+        if not 0.0 <= self.p <= 1.0:
+            raise ValueError("p must be in [0, 1] (got %r)" % (self.p,))
+
+    def matches(self, label: str | None, bucket: int | None,
+                arrival: int) -> bool:
+        if self.construction is not None and label != self.construction:
+            return False
+        if self.bucket is not None and bucket != self.bucket:
+            return False
+        if arrival < self.start:
+            return False
+        return self.stop is None or arrival < self.stop
+
+    def as_dict(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+
+class FaultPlan:
+    """An immutable list of ``FaultSpec`` plus the seed that makes every
+    injection decision reproducible.  ``injector()`` mints the runtime
+    object the engines consult."""
+
+    def __init__(self, specs=(), seed: int = 0):
+        self.specs = tuple(specs)
+        self.seed = int(seed)
+
+    def injector(self) -> "FaultInjector":
+        return FaultInjector(self)
+
+    def as_dict(self) -> dict:
+        return {"seed": self.seed,
+                "specs": [s.as_dict() for s in self.specs]}
+
+
+class FaultInjector:
+    """Runtime fault oracle, consulted at the engine injection points.
+
+    The harness calls ``begin_arrival(j)`` before each arrival's
+    submit; every consult then decides by a seeded hash of
+    (spec, arrival, consult-count) — deterministic, order-independent
+    across specs, replayable.  ``injected`` counts fires per kind;
+    ``corruptions`` lists (construction, arrival) per corrupted batch
+    so the bench can prove 0 bit-gate escapes.
+    """
+
+    def __init__(self, plan: FaultPlan):
+        self.plan = plan
+        self.arrival = -1             # -1 = outside any arrival (warmup)
+        self.injected = {k: 0 for k in KINDS}
+        self.corruptions = []         # (construction, arrival)
+        self._consults = {}           # (spec_idx, arrival) -> count
+        self._fires = {}              # spec_idx -> total fires
+        self._dead = set()            # id(engine) of poisoned engines
+        self._lock = threading.Lock()
+
+    def begin_arrival(self, j: int) -> None:
+        self.arrival = int(j)
+
+    # ------------------------------------------------------ decisions
+
+    def _fires_left(self, idx: int, spec: FaultSpec) -> bool:
+        cap = 1 if spec.kind == "engine_death" else spec.max_fires
+        return cap is None or self._fires.get(idx, 0) < cap
+
+    def _decide(self, idx: int, spec: FaultSpec) -> bool:
+        """One deterministic draw for (spec, current arrival, consult
+        count).  Repeated consults at the same arrival (multi-chunk
+        batches, retries) draw independently, so a retry CAN succeed
+        against a probabilistic fault."""
+        key = (idx, self.arrival)
+        with self._lock:
+            consult = self._consults.get(key, 0)
+            self._consults[key] = consult + 1
+        if spec.p >= 1.0:
+            fired = True
+        else:
+            rng = np.random.default_rng(
+                (self.plan.seed, idx, self.arrival + 1, consult))
+            fired = bool(rng.random() < spec.p)
+        if fired:
+            with self._lock:
+                if not self._fires_left(idx, spec):
+                    return False
+                self._fires[idx] = self._fires.get(idx, 0) + 1
+                self.injected[spec.kind] += 1
+        return fired
+
+    def _firing(self, kinds, label, bucket):
+        for idx, spec in enumerate(self.plan.specs):
+            if (spec.kind in kinds and self._fires_left(idx, spec)
+                    and spec.matches(label, bucket, self.arrival)
+                    and self._decide(idx, spec)):
+                yield spec
+
+    # ----------------------------------------------- injection points
+
+    def on_dispatch(self, engine, bucket: int) -> None:
+        """Consulted by ``ServingEngine.submit`` immediately before each
+        chunk's device dispatch.  May sleep (latency), poison the engine
+        (engine_death -> ``EngineDead``), or raise
+        ``InjectedDispatchError``; the engine's existing partial-unwind
+        handles either exception."""
+        label = getattr(engine, "label", None)
+        if id(engine) in self._dead:
+            raise EngineDead("engine %r is dead (injected)" % (label,))
+        for spec in self._firing(("engine_death",), label, bucket):
+            self._dead.add(id(engine))
+            raise EngineDead("engine %r killed at arrival %d (injected)"
+                             % (label, self.arrival))
+        for spec in self._firing(("latency",), label, bucket):
+            time.sleep(spec.latency_s)
+        for _ in self._firing(("dispatch_error",), label, bucket):
+            raise InjectedDispatchError(
+                "dispatch failed at arrival %d on %r (injected)"
+                % (self.arrival, label))
+
+    def on_result(self, engine, bucket: int, out):
+        """Consulted by ``ServingEngine._resolve_one`` on the resolved
+        host rows: a firing corrupt spec returns a bit-flipped COPY (the
+        XOR keeps the corruption silent-looking — right shape/dtype,
+        wrong value — exactly what the bit gate must catch)."""
+        label = getattr(engine, "label", None)
+        for _ in self._firing(("corrupt_shares",), label, bucket):
+            bad = np.array(out, copy=True)
+            if bad.size:
+                bad.flat[0] ^= np.int32(1 << 7)
+            self.corruptions.append((label, self.arrival))
+            return bad
+        return out
+
+    def on_warmup(self, engine, bucket: int) -> None:
+        """Consulted before each warmup/probe precompile dispatch: a
+        dead engine stays dead, and compile_error specs fire here."""
+        label = getattr(engine, "label", None)
+        if id(engine) in self._dead:
+            raise EngineDead("engine %r is dead (injected)" % (label,))
+        for _ in self._firing(("compile_error",), label, bucket):
+            raise InjectedCompileError(
+                "precompile failed for %r bucket %d (injected)"
+                % (label, bucket))
+
+    def is_dead(self, engine) -> bool:
+        return id(engine) in self._dead
+
+    def stats(self) -> dict:
+        return {"injected": dict(self.injected),
+                "corrupted_batches": len(self.corruptions)}
+
+
+# --------------------------------------------------------------- retry
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Bounded retries with exponential backoff and seeded jitter.
+
+    ``max_attempts`` counts the first try; backoff before attempt k+1
+    is ``backoff_s * backoff_mult**(k-1) * (1 + jitter * u)`` with u
+    drawn from a seeded rng (deterministic sleep schedule under the
+    seed).  ``LoadShed`` and ``DeadlineExceeded`` are never retryable:
+    admission control and deadlines are *decisions*, not faults —
+    retrying them would defeat the mechanisms (and double-count sheds).
+    """
+    max_attempts: int = 3
+    backoff_s: float = 0.005
+    backoff_mult: float = 2.0
+    jitter: float = 0.5
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1 (got %d)"
+                             % self.max_attempts)
+        self._rng = np.random.default_rng(self.seed)
+
+    def retryable(self, exc: BaseException) -> bool:
+        if isinstance(exc, (LoadShed, DeadlineExceeded)):
+            return False
+        return isinstance(exc, Exception)
+
+    def backoff(self, attempt: int) -> float:
+        """Backoff (seconds) after failed attempt ``attempt`` (1-based)."""
+        base = self.backoff_s * self.backoff_mult ** max(0, attempt - 1)
+        return base * (1.0 + self.jitter * float(self._rng.random()))
+
+    def sleep(self, attempt: int) -> None:
+        dt = self.backoff(attempt)
+        if dt > 0:
+            time.sleep(dt)
+
+
+def submit_with_retry(submit, policy: RetryPolicy, stats=None):
+    """Run ``submit()`` under ``policy``: on a retryable failure, back
+    off and re-try (counting ``stats.retries``) up to ``max_attempts``.
+    The callable must be retry-safe — ``ServingEngine.submit``'s
+    partial-unwind guarantees the engine is, so wrapping it (or a
+    whole-batch resubmit) directly is sound."""
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return submit()
+        except BaseException as e:
+            if (not policy.retryable(e)
+                    or attempt >= policy.max_attempts):
+                raise
+            if stats is not None:
+                stats.retries += 1
+            policy.sleep(attempt)
+
+
+# ------------------------------------------------------------- breaker
+
+class CircuitBreaker:
+    """Per-construction circuit breaker (serve/router.py).
+
+    ``failures`` CONSECUTIVE failures trip closed -> open: the router
+    then excludes the construction from the cost-model argmin, so its
+    traffic fails over to the healthy engines over the same table.
+    After ``reset_s`` the next availability check moves open ->
+    half_open exactly once (``should_probe`` returns True); the router
+    re-probes via the existing ``ServingEngine.probe`` and reports the
+    outcome — success closes the breaker, failure re-opens it with a
+    fresh timer.  Any observed SUCCESS closes the breaker from any
+    state (real traffic succeeding is stronger evidence than any
+    probe).  ``transitions`` records (elapsed_s, state) for the bench.
+    """
+
+    STATES = ("closed", "open", "half_open")
+
+    def __init__(self, failures: int = 3, reset_s: float = 30.0,
+                 on_open=None):
+        if failures < 1:
+            raise ValueError("failures must be >= 1 (got %d)" % failures)
+        self.failures = int(failures)
+        self.reset_s = float(reset_s)
+        self.state = "closed"
+        self.consecutive = 0
+        self.opened_at = None
+        self.on_open = on_open        # callback(breaker) on closed->open
+        self.opens = 0
+        self._t0 = time.monotonic()
+        self.transitions = [(0.0, "closed")]
+
+    def _to(self, state: str) -> None:
+        if state == self.state:
+            return
+        if state == "open":
+            self.opened_at = time.monotonic()
+            self.opens += 1
+        self.state = state
+        self.transitions.append(
+            (round(time.monotonic() - self._t0, 4), state))
+        if state == "open" and self.on_open is not None:
+            self.on_open(self)
+
+    def record_failure(self) -> str:
+        self.consecutive += 1
+        if self.state == "half_open":
+            self._to("open")          # probe failed: fresh timer
+        elif self.state == "closed" and self.consecutive >= self.failures:
+            self._to("open")
+        elif self.state == "open":
+            self.opened_at = time.monotonic()   # still failing: re-arm
+        return self.state
+
+    def record_success(self) -> str:
+        self.consecutive = 0
+        self._to("closed")
+        return self.state
+
+    def available(self) -> bool:
+        """True when routing may use this construction (closed)."""
+        return self.state == "closed"
+
+    def should_probe(self) -> bool:
+        """True exactly once per open period after ``reset_s`` elapsed;
+        transitions open -> half_open as a side effect."""
+        if (self.state == "open" and self.opened_at is not None
+                and time.monotonic() - self.opened_at >= self.reset_s):
+            self._to("half_open")
+            return True
+        return self.state == "half_open"
+
+    def as_dict(self) -> dict:
+        return {"state": self.state, "opens": self.opens,
+                "consecutive_failures": self.consecutive,
+                "transitions": [list(t) for t in self.transitions]}
+
+    def __repr__(self):
+        return ("CircuitBreaker(state=%s, consecutive=%d/%d, opens=%d)"
+                % (self.state, self.consecutive, self.failures,
+                   self.opens))
+
+
+# ---------------------------------------------------------- supervisor
+
+class EngineSupervisor:
+    """Detect-and-rebuild for a router's per-construction engines.
+
+    ``notify(label)`` (the router calls it when a submit raises
+    ``EngineDead``, or a half-open probe finds a dead engine) rebuilds
+    that construction's engine over the SAME prepared server — table
+    upload, tuned knobs, and bucket ladder are all reused — and
+    re-warms it, by default in a background thread so the router keeps
+    serving degraded on the healthy constructions meanwhile.  On
+    success the new engine (old counters merged in, so history
+    survives the swap) replaces the dead one and
+    ``recovery.engine_restarts`` moves; the breaker stays open until
+    its half-open re-probe observes the rebuilt engine working.  A
+    failed rebuild (injected compile fault, dead-again engine) leaves
+    the old engine in place — the next probe failure notifies again.
+    """
+
+    def __init__(self, router, background: bool = True):
+        self._router = router
+        self.background = bool(background)
+        self._rebuilding = set()
+        self._threads = []
+        self._lock = threading.Lock()
+        self.failed_rebuilds = 0
+
+    def notify(self, label: str) -> bool:
+        """Request a rebuild of ``label``'s engine; returns False when a
+        rebuild for it is already in flight."""
+        with self._lock:
+            if label in self._rebuilding:
+                return False
+            self._rebuilding.add(label)
+        if self.background:
+            t = threading.Thread(target=self._rebuild, args=(label,),
+                                 name="dpf-rebuild-%s" % label,
+                                 daemon=True)
+            self._threads.append(t)
+            t.start()
+        else:
+            self._rebuild(label)
+        return True
+
+    def _rebuild(self, label: str) -> None:
+        r = self._router
+        try:
+            old = r.engines[label]
+            fresh = ServingEngine(r.server(label), buckets=r.buckets,
+                                  label=label, injector=r.injector,
+                                  **r._engine_kw)
+            fresh.warmup()            # re-warm BEFORE taking traffic
+            fresh.stats.merge(old.stats)
+            r.engines[label] = fresh
+            r.recovery.engine_restarts += 1
+        except Exception:
+            with self._lock:
+                self.failed_rebuilds += 1
+        finally:
+            with self._lock:
+                self._rebuilding.discard(label)
+
+    def join(self, timeout: float | None = None) -> None:
+        """Wait for outstanding background rebuilds (bench shutdown)."""
+        for t in self._threads:
+            t.join(timeout)
+        self._threads = [t for t in self._threads if t.is_alive()]
+
+    def rebuilding(self) -> tuple:
+        with self._lock:
+            return tuple(sorted(self._rebuilding))

@@ -68,6 +68,17 @@ def total_queries(trace) -> int:
     return sum(batch_sizes(trace))
 
 
+def squeeze(trace, factor: float) -> list:
+    """The same arrival sequence compressed in time by ``factor`` (> 1
+    = hotter: identical batches delivered ``factor``x faster).  The
+    overload leg of the load harness and the chaos bench both replay
+    the SAME seeded trace squeezed, so "what changed" between legs is
+    only the offered rate, never the batch mix."""
+    if factor <= 0:
+        raise ValueError("factor must be > 0 (got %r)" % (factor,))
+    return [Arrival(a.t / factor, a.n, a.batch) for a in trace]
+
+
 def _draw_batch(rng, lo: int, hi: int) -> int:
     """Log-uniform batch size in [lo, hi]: small batches must be common
     enough to exercise the lower ladder rungs, big ones common enough

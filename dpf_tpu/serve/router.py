@@ -37,9 +37,12 @@ from __future__ import annotations
 
 import time
 
-from ..utils.profiling import EngineCounters
+from ..core.expand import DeadlineExceeded
+from ..utils.profiling import EngineCounters, note_swallowed
 from .buckets import Buckets
-from .engine import ServingEngine
+from .engine import LoadShed, ServingEngine
+from .faults import (CircuitBreaker, EngineDead, EngineSupervisor,
+                     RetryPolicy)
 
 #: construction labels the router can serve, in race order
 LABELS = ("logn", "radix4", "sqrtn")
@@ -80,7 +83,8 @@ def resolve_sticky(n: int, entry_size: int, prf_method: int, cap: int,
     try:
         knobs = lookup_scheme(n=n, entry_size=entry_size, batch=cap,
                               prf_method=prf_method)
-    except Exception:           # cache must never break serving
+    except Exception as e:      # cache must never break serving
+        note_swallowed("serve.router.resolve_sticky", e)
         knobs = None
     if knobs:
         win = knobs.get("construction")
@@ -135,12 +139,19 @@ class RoutedFuture:
         return self._fut.done()
 
     def result(self):
-        out = self._fut.result()
+        try:
+            out = self._fut.result()
+        except (LoadShed, DeadlineExceeded):
+            raise               # admission decisions, not engine faults
+        except Exception as e:
+            self._router._note_failure(self.decision.construction, e)
+            raise
         if not self._observed:
             self._observed = True
             dt = (time.perf_counter() - self._t0) / max(1, self._chunks)
             self._router._observe(self.decision.construction,
                                   self.decision.bucket, dt)
+            self._router._note_success(self.decision.construction)
         return out
 
 
@@ -165,6 +176,17 @@ class SchemeRouter:
         to the sticky cached winner until observations accumulate.
       slo_s / max_queue_depth / shed: per-engine admission control
         (docs/SERVING.md "Load testing & SLOs").
+      injector: optional ``faults.FaultInjector`` threaded into every
+        engine (chaos testing — docs/SERVING.md "Fault tolerance").
+      retry: default ``faults.RetryPolicy`` for ``submit_resilient``.
+      breaker_failures / breaker_reset_s: per-construction circuit
+        breaker — ``breaker_failures`` consecutive engine faults open
+        it (excluded from routing); after ``breaker_reset_s`` a
+        half-open re-probe (``ServingEngine.probe``) decides whether it
+        re-closes.
+      supervise: rebuild a dead engine over its prepared server in a
+        background thread (``faults.EngineSupervisor``) while the
+        router serves degraded on the remaining constructions.
 
     ``routed_from`` mirrors ``DPF.scheme_resolved_from``: the provenance
     of the most recent routing decision ("cost-model", "cache", or
@@ -177,7 +199,11 @@ class SchemeRouter:
                  warmup: bool = True, probe: bool = True,
                  probe_reps: int = 1, slo_s: float | None = None,
                  max_queue_depth: int | None = None, shed: bool = False,
-                 servers: dict | None = None):
+                 servers: dict | None = None, injector=None,
+                 retry: RetryPolicy | None = None,
+                 breaker_failures: int = 5,
+                 breaker_reset_s: float = 30.0,
+                 supervise: bool = False):
         from ..api import DPF
         if not 0 < ewma_alpha <= 1:
             raise ValueError("ewma_alpha must be in (0, 1] (got %r)"
@@ -220,12 +246,29 @@ class SchemeRouter:
         self.buckets = (buckets if isinstance(buckets, Buckets)
                         else Buckets(buckets if buckets is not None
                                      else Buckets.default_sizes(cap)))
+        self.injector = injector
+        self.retry = retry
+        # kept for EngineSupervisor rebuilds: a fresh engine must get
+        # the SAME admission knobs as the one it replaces
+        self._engine_kw = dict(max_in_flight=max_in_flight,
+                               max_queue_depth=max_queue_depth,
+                               slo_s=slo_s, shed=shed)
         self.engines = {
-            lb: ServingEngine(srv, max_in_flight=max_in_flight,
-                              buckets=self.buckets,
-                              max_queue_depth=max_queue_depth,
-                              slo_s=slo_s, shed=shed)
+            lb: ServingEngine(srv, buckets=self.buckets, label=lb,
+                              injector=injector, **self._engine_kw)
             for lb, srv in self._servers.items()}
+        # ---- recovery machinery: per-construction breaker + counters
+        self.recovery = EngineCounters()
+
+        def _opened(_lb=None):
+            self.recovery.breaker_opens += 1
+        self.breakers = {
+            lb: CircuitBreaker(failures=breaker_failures,
+                               reset_s=breaker_reset_s,
+                               on_open=_opened)
+            for lb in labels}
+        self.supervisor = (EngineSupervisor(self) if supervise
+                           else None)
         # ---- sticky fallback + cost-model seed from the tuning cache
         self._costs = {}            # (label, bucket) -> EWMA seconds
         self._obs_age = {}          # (label, bucket) -> routes at this
@@ -264,8 +307,8 @@ class SchemeRouter:
                     lb = row.get("construction")
                     if lb in self._servers and row.get("tuned_s"):
                         self._costs[(lb, cap)] = float(row["tuned_s"])
-        except Exception:       # cache must never break serving
-            pass
+        except Exception as e:  # cache must never break serving
+            note_swallowed("serve.router.cost_seed", e)
         return resolve_sticky(self.n, self.entry_size, self.prf_method,
                               cap, available=self.constructions)
 
@@ -295,10 +338,56 @@ class SchemeRouter:
 
     # ----------------------------------------------------------- routing
 
-    def route(self, batch: int) -> RouteDecision:
+    def _available(self, exclude=()) -> tuple:
+        """Constructions routing may use right now: not excluded, and
+        circuit breaker closed.  Visiting an open breaker runs its
+        half-open re-probe when ``reset_s`` has elapsed — recovery is
+        checked on the routing path itself, no background poller.  When
+        every construction is excluded/open the router DEGRADES rather
+        than refuses: all non-excluded constructions are returned (a
+        guess at a broken engine still beats a guaranteed error)."""
+        avail = []
+        for lb in self.constructions:
+            if lb in exclude:
+                continue
+            br = self.breakers[lb]
+            if not br.available() and br.should_probe():
+                self._probe_breaker(lb)
+            if br.available():
+                avail.append(lb)
+        if not avail:
+            avail = [lb for lb in self.constructions
+                     if lb not in exclude] or list(self.constructions)
+        return tuple(avail)
+
+    def _probe_breaker(self, lb: str) -> None:
+        """Half-open re-probe: one timed dispatch per bucket through the
+        (possibly rebuilt) engine.  Success refreshes the cost model for
+        every bucket AND closes the breaker; failure re-opens it (fresh
+        timer) and, on ``EngineDead``, wakes the supervisor."""
+        try:
+            for size, dt in self.engines[lb].probe(reps=1).items():
+                self._observe(lb, size, dt)
+        except Exception as e:
+            self.breakers[lb].record_failure()
+            if isinstance(e, EngineDead) and self.supervisor is not None:
+                self.supervisor.notify(lb)
+        else:
+            self.breakers[lb].record_success()
+
+    def _note_failure(self, lb: str, exc: BaseException) -> None:
+        """Engine fault bookkeeping shared by submit/result paths."""
+        self.breakers[lb].record_failure()
+        if isinstance(exc, EngineDead) and self.supervisor is not None:
+            self.supervisor.notify(lb)
+
+    def _note_success(self, lb: str) -> None:
+        self.breakers[lb].record_success()
+
+    def route(self, batch: int, exclude=()) -> RouteDecision:
         """Pick the construction for a ``batch``-query arrival.
 
-        Cost-model routing needs an estimate for EVERY enabled
+        Cost-model routing needs an estimate for EVERY available
         construction at the batch's bucket (comparing a measured
         construction against unmeasured ones would lock onto whichever
         happened to be observed first); anything less falls back to the
@@ -308,18 +397,24 @@ class SchemeRouter:
         whose estimate is stalest gets the batch instead of the argmin
         (``routed_from="explore"``) so its EWMA re-measures and a
         poisoned estimate self-corrects.
+
+        ``exclude`` names constructions this call must avoid (failover
+        after their engine faulted); open circuit breakers are excluded
+        automatically.  When the sticky winner itself is unavailable
+        the cheapest available construction answers instead with
+        ``routed_from="failover"``.
         """
         if batch < 1:
             raise ValueError("batch must be >= 1 (got %d)" % batch)
         bucket = (self.buckets.bucket_for(batch)
                   if batch <= self.buckets.max else self.buckets.max)
-        costs = {lb: self._costs.get((lb, bucket))
-                 for lb in self.constructions}
+        avail = self._available(exclude)
+        costs = {lb: self._costs.get((lb, bucket)) for lb in avail}
         if all(c is not None for c in costs.values()):
-            for lb in self.constructions:
+            for lb in avail:
                 self._obs_age[(lb, bucket)] = (
                     self._obs_age.get((lb, bucket), 0) + 1)
-            stalest = max(self.constructions,
+            stalest = max(avail,
                           key=lambda lb: self._obs_age[(lb, bucket)])
             if self._obs_age[(stalest, bucket)] >= self.EXPLORE_EVERY:
                 label, routed_from = stalest, "explore"
@@ -332,8 +427,14 @@ class SchemeRouter:
             else:
                 label = min(costs, key=costs.get)
                 routed_from = "cost-model"
-        else:
+        elif self.sticky in avail:
             label, routed_from = self.sticky, self.sticky_resolved_from
+        else:
+            # sticky winner is down: cheapest available estimate, else
+            # first available — provenance says this was a failover
+            known = {lb: c for lb, c in costs.items() if c is not None}
+            label = (min(known, key=known.get) if known else avail[0])
+            routed_from = "failover"
         self.routed_from = routed_from
         self.route_counts[label] += 1
         self.routed_from_counts[routed_from] = (
@@ -344,12 +445,68 @@ class SchemeRouter:
         """Dispatch ``keys`` (minted for ``decision.construction`` —
         ``server(label).gen``) through that construction's engine;
         returns a ``RoutedFuture`` whose resolution feeds the observed
-        service time back into the cost model."""
+        service time back into the cost model.  Engine faults (anything
+        but the ``LoadShed``/``DeadlineExceeded`` admission decisions)
+        count against the construction's circuit breaker before
+        re-raising; ``EngineDead`` additionally wakes the supervisor."""
         engine = self.engines[decision.construction]
         chunks = len(engine.buckets.chunks(len(keys)))
         t0 = time.perf_counter()
-        fut = engine.submit(keys)
+        try:
+            fut = engine.submit(keys)
+        except (LoadShed, DeadlineExceeded):
+            raise               # admission decisions, not engine faults
+        except Exception as e:
+            self._note_failure(decision.construction, e)
+            raise
         return RoutedFuture(self, fut, decision, t0, chunks)
+
+    def submit_resilient(self, batch: int, keys_for, *, retry=None,
+                         exclude=()) -> RoutedFuture:
+        """Route + submit with retry AND construction failover.
+
+        ``keys_for(label)`` mints/fetches the keys for a construction
+        (keys are construction-specific, so failover must re-mint).
+        Each attempt routes fresh — ``EngineDead`` (and any breaker
+        opened by earlier failures) excludes that construction, so the
+        retry lands on a healthy engine over the same table; transient
+        faults retry the same construction after the policy's backoff.
+        Counts ``recovery.retries`` per re-attempt and
+        ``recovery.failovers`` when the construction changed.
+        ``LoadShed``/``DeadlineExceeded`` propagate immediately (never
+        retried).  The returned future resolves the SUCCESSFUL submit;
+        failures surfacing later in ``result()`` are the caller's to
+        handle (resolution happens outside this call's scope).
+        """
+        policy = retry or self.retry or RetryPolicy()
+        excluded = set(exclude)
+        last_label = None
+        attempt = 0
+        while True:
+            attempt += 1
+            decision = self.route(batch, exclude=excluded)
+            if (last_label is not None
+                    and decision.construction != last_label):
+                self.recovery.failovers += 1
+            last_label = decision.construction
+            try:
+                return self.submit(decision, keys_for(decision.construction))
+            except (LoadShed, DeadlineExceeded):
+                raise
+            except Exception as e:
+                if (not policy.retryable(e)
+                        or attempt >= policy.max_attempts):
+                    raise
+                self.recovery.retries += 1
+                if isinstance(e, EngineDead):
+                    # dead engines don't heal within a backoff window:
+                    # fail over NOW, no sleep
+                    excluded.add(decision.construction)
+                    if len(excluded) >= len(self.constructions):
+                        excluded.clear()   # everything down: retry all
+                        policy.sleep(attempt)
+                else:
+                    policy.sleep(attempt)
 
     # ---------------------------------------------------------- plumbing
 
@@ -379,20 +536,24 @@ class SchemeRouter:
         resolution — is kept."""
         for engine in self.engines.values():
             engine.stats.reset()
+        self.recovery.reset()
         self.route_counts = {lb: 0 for lb in self.constructions}
         self.routed_from_counts = {}
 
     def counters(self) -> EngineCounters:
         """All engines' counters merged into one record
-        (``EngineCounters.merge``) — the router-level SLO view."""
+        (``EngineCounters.merge``), plus the router-level recovery
+        events (retries/failovers/breaker opens/restarts) — the
+        router-level SLO view."""
         agg = EngineCounters()
         for engine in self.engines.values():
             agg.merge(engine.stats)
+        agg.merge(self.recovery)
         return agg
 
     def stats(self) -> dict:
         """Routing + serving diagnostics for benchmark records."""
-        return {
+        out = {
             "constructions": list(self.constructions),
             "sticky": self.sticky,
             "sticky_resolved_from": self.sticky_resolved_from,
@@ -406,7 +567,16 @@ class SchemeRouter:
             "counters": self.counters().as_dict(),
             "per_engine": {lb: e.stats.as_dict()
                            for lb, e in self.engines.items()},
+            "breakers": {lb: br.as_dict()
+                         for lb, br in self.breakers.items()},
         }
+        if self.supervisor is not None:
+            out["supervisor"] = {
+                "failed_rebuilds": self.supervisor.failed_rebuilds,
+                "rebuilding": list(self.supervisor.rebuilding())}
+        if self.injector is not None:
+            out["faults"] = self.injector.stats()
+        return out
 
     def __repr__(self):
         return ("SchemeRouter(n=%d, constructions=%s, sticky=%s/%s, "

@@ -30,7 +30,7 @@ import json
 import os
 import tempfile
 
-from ..utils.profiling import CACHE_COUNTERS
+from ..utils.profiling import CACHE_COUNTERS, note_swallowed
 from .fingerprint import cache_key
 
 _ENV = "DPF_TPU_TUNE_CACHE"
@@ -64,14 +64,21 @@ class TuningCache:
     def __init__(self, path: str | None = None):
         self.path = path if path is not None else default_path()
         self.entries: dict = {}
+        self.load_error: str | None = None
         if self.path and os.path.exists(self.path):
             try:
                 with open(self.path) as f:
                     data = json.load(f)
                 if data.get("version") == VERSION:
                     self.entries = dict(data.get("entries", {}))
-            except (OSError, ValueError):
-                self.entries = {}  # corrupt cache = cold cache
+            except (OSError, ValueError) as e:
+                # corrupt cache = cold cache (tuning degrades to the
+                # heuristics), but the cause stays visible: load_error
+                # for callers, the swallowed-error registry + one-shot
+                # warning for operators
+                self.entries = {}
+                self.load_error = "%s: %s" % (type(e).__name__, e)
+                note_swallowed("tune.cache.load", e)
 
     # ------------------------------------------------------------ lookups
 
@@ -183,7 +190,8 @@ def lookup_eval_knobs(*, n: int, entry_size: int, batch: int,
         return default_cache().lookup_knobs(
             "eval", nearest_batch=True, n=n, entry_size=entry_size,
             batch=batch, prf_method=prf_method, scheme=scheme, radix=radix)
-    except Exception:  # pragma: no cover — cache must never break serving
+    except Exception as e:  # pragma: no cover — never break serving
+        note_swallowed("tune.cache.lookup_eval_knobs", e)
         return None
 
 
@@ -200,7 +208,8 @@ def lookup_mesh_knobs(*, n: int, entry_size: int, batch: int,
             "mesh", nearest_batch=True, n=n, entry_size=entry_size,
             batch=batch, prf_method=prf_method, scheme=scheme,
             radix=radix, mesh=mesh)
-    except Exception:  # pragma: no cover — cache must never break serving
+    except Exception as e:  # pragma: no cover — never break serving
+        note_swallowed("tune.cache.lookup_mesh_knobs", e)
         return None
 
 
@@ -214,5 +223,6 @@ def lookup_scheme(*, n: int, entry_size: int, batch: int,
         return default_cache().lookup_knobs(
             "scheme", nearest_batch=True, n=n, entry_size=entry_size,
             batch=batch, prf_method=prf_method, scheme="any", radix=0)
-    except Exception:  # pragma: no cover — cache must never break serving
+    except Exception as e:  # pragma: no cover — never break serving
+        note_swallowed("tune.cache.lookup_scheme", e)
         return None

@@ -11,6 +11,7 @@ import contextlib
 import dataclasses
 import os
 import time
+import warnings
 
 
 @contextlib.contextmanager
@@ -162,6 +163,14 @@ class EngineCounters:
     deadline_misses: int = 0      # cooperative-deadline trips
     shed_batches: int = 0         # batches rejected by admission control
     shed_queries: int = 0         # queries inside those batches
+    # fault-tolerance accounting (serve/faults.py, docs/SERVING.md
+    # "Fault tolerance & chaos testing"): additive like the counters
+    # above, so they flow through merge()/as_dict unchanged
+    retries: int = 0              # re-attempts after a failed submit
+    failovers: int = 0            # batches moved to another construction
+    breaker_opens: int = 0        # circuit-breaker closed->open trips
+    engine_restarts: int = 0      # supervisor engine rebuilds
+    swallowed_errors: int = 0     # caught-and-suppressed exceptions
     #: bounded ring of recent per-batch latencies (seconds); leading
     #: underscore keeps the raw samples out of as_dict — records carry
     #: the quantiles, not 2048 floats
@@ -290,6 +299,50 @@ class CacheCounters:
 
 
 CACHE_COUNTERS = CacheCounters()
+
+
+#: process-wide registry of caught-and-suppressed exceptions:
+#: site -> {exception class name -> count}.  The serving stack has
+#: several deliberate "must never break serving" suppression points
+#: (cache lookups, compile-cache enable, diagnostics); before this
+#: registry they discarded the cause entirely, so a misconfigured cache
+#: was indistinguishable from a cold one.  ``note_swallowed`` is the
+#: one spelling of "suppress but stay diagnosable".
+SWALLOWED_ERRORS: dict = {}
+_SWALLOWED_WARNED: set = set()
+
+
+def note_swallowed(site: str, exc: BaseException, stats=None) -> None:
+    """Record a deliberately suppressed exception.
+
+    Increments ``SWALLOWED_ERRORS[site][type(exc).__name__]``, bumps
+    ``stats.swallowed_errors`` when an ``EngineCounters`` is supplied,
+    and emits ONE ``RuntimeWarning`` per (site, exception class) per
+    process — loud enough to see in logs, quiet enough not to spam a
+    serving loop that hits the same broken cache on every lookup.
+    Never raises (it guards suppression sites)."""
+    try:
+        cls = type(exc).__name__
+        SWALLOWED_ERRORS.setdefault(site, {})
+        SWALLOWED_ERRORS[site][cls] = SWALLOWED_ERRORS[site].get(cls, 0) + 1
+        if stats is not None:
+            stats.swallowed_errors += 1
+        if (site, cls) not in _SWALLOWED_WARNED:
+            _SWALLOWED_WARNED.add((site, cls))
+            warnings.warn(
+                "suppressed %s at %s: %s (further occurrences counted "
+                "in dpf_tpu.utils.profiling.SWALLOWED_ERRORS, not "
+                "re-warned)" % (cls, site, exc), RuntimeWarning,
+                stacklevel=3)
+    except Exception:
+        pass
+
+
+def swallowed_snapshot() -> dict:
+    """A JSON-ready copy of the swallowed-error registry (benchmark
+    records embed it so suppressed causes are visible in artifacts)."""
+    return {site: dict(by_cls) for site, by_cls in
+            sorted(SWALLOWED_ERRORS.items())}
 
 
 class Timer:
