@@ -12,6 +12,7 @@
 #   --multichip        serve/bench_multichip.py     MULTICHIP_r06.json
 #   --load             serve/bench_load.py          BENCH_LOAD_r10.json
 #   --chaos            serve/bench_chaos.py         BENCH_CHAOS_r11.json
+#   --trace            obs/bench_trace.py           BENCH_TRACE_r12.json
 #
 # --serve: streaming serving benchmark (blocking loop vs pipelined
 # ServingEngine).  See docs/SERVING.md.
@@ -49,6 +50,14 @@
 # transitions and engine restarts, every served batch still gated;
 # --dryrun is the seconds-long CI smoke.  See docs/SERVING.md "Fault
 # tolerance & chaos testing".
+#
+# --trace: end-to-end observability — span tracing over the serving
+# path with a joint host+device digest for one tuned shape, the
+# OpenMetrics snapshot (engine/router/breaker series), a chaos slice
+# whose flight-recorder dump attributes injected faults to their route
+# decisions, and the measured tracing-on vs tracing-off qps delta on
+# the bursty trace (gated at <= 2%); --dryrun is the seconds-long CI
+# smoke.  See docs/OBSERVABILITY.md.
 
 import sys
 
@@ -128,6 +137,10 @@ if __name__ == "__main__":
     if "--chaos" in sys.argv:
         from dpf_tpu.serve.bench_chaos import main
         main([a for a in sys.argv[1:] if a != "--chaos"])
+        sys.exit(0)
+    if "--trace" in sys.argv:
+        from dpf_tpu.obs.bench_trace import main
+        main([a for a in sys.argv[1:] if a != "--trace"])
         sys.exit(0)
     if "--autotune-scheme" in sys.argv:
         _autotune_scheme_main(

@@ -30,6 +30,8 @@ from dataclasses import asdict, dataclass, field
 
 import numpy as np
 
+from ..obs.tracer import span
+
 
 # the one scheme/radix membership rule, shared with the DPF ctor
 from ..utils.config import check_construction as _check_construction_args
@@ -787,19 +789,23 @@ class LookupStream:
         if len(keys_per_bin) != self._n_bins:
             raise ValueError("expected one key per bin (%d bins), got %d"
                              % (self._n_bins, len(keys_per_bin)))
-        decoded = [
-            (grp, eng, self._server._decode_group(
-                n, grp, [keys_per_bin[bi] for bi in grp.idxs]))
-            for n, grp, eng in self._engines]
-        if self._retry is None:
-            parts = [(grp, eng.submit(pk)) for grp, eng, pk in decoded]
-        else:
-            from ..serve.faults import submit_with_retry
-            parts = [(grp, submit_with_retry(
-                lambda eng=eng, pk=pk: eng.submit(pk), self._retry,
-                stats=eng.stats)) for grp, eng, pk in decoded]
-        return LookupRoundFuture(self._n_bins, self._server.entry_size,
-                                 parts)
+        with span("round", bins=self._n_bins,
+                  groups=len(self._engines)):
+            with span("pack", phase="group_decode"):
+                decoded = [
+                    (grp, eng, self._server._decode_group(
+                        n, grp, [keys_per_bin[bi] for bi in grp.idxs]))
+                    for n, grp, eng in self._engines]
+            if self._retry is None:
+                parts = [(grp, eng.submit(pk))
+                         for grp, eng, pk in decoded]
+            else:
+                from ..serve.faults import submit_with_retry
+                parts = [(grp, submit_with_retry(
+                    lambda eng=eng, pk=pk: eng.submit(pk), self._retry,
+                    stats=eng.stats)) for grp, eng, pk in decoded]
+            return LookupRoundFuture(self._n_bins,
+                                     self._server.entry_size, parts)
 
     def drain(self) -> None:
         """Resolve every outstanding dispatch across all group engines."""

@@ -1,0 +1,42 @@
+"""End-to-end serving observability (docs/OBSERVABILITY.md).
+
+Three coupled pieces, one per module:
+
+* ``tracer``  — per-batch span tracing (``submit`` > ``admit`` /
+  ``pack`` / ``dispatch``, ``wait``, ``decode``, ``route``, ``retry``,
+  ``failover``, ``rebuild``), bounded ring, JSONL + Chrome-trace
+  export for Perfetto, joint host+device digest via
+  ``utils.profiling.summarize_trace``.  Off by default — the serving
+  hot path pays one global read (``span()`` returns the shared no-op).
+* ``metrics`` — typed Counter/Gauge/Histogram registry with an
+  OpenMetrics text exporter and JSON snapshot; ``EngineCounters``,
+  ``CacheCounters``, ``SWALLOWED_ERRORS``, breaker states and the
+  router's EWMA cost table self-register as first-class series.
+* ``flight``  — a bounded ring of recent structured DECISIONS (route,
+  shed, breaker transition, retry, failover, injected fault, rebuild),
+  dumpable on demand and embedded in benchmark records.
+
+``benchmark.py --trace`` (``obs/bench_trace.py``) captures a joint
+host+device profile for one tuned shape and measures the whole stack's
+overhead (committed record: BENCH_TRACE_r12.json).
+"""
+
+from .flight import FLIGHT, FlightRecorder, flight_dump  # noqa: F401
+from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, default_registry,
+                      register_engine, register_router)
+from .tracer import (NULL_SPAN, Span, Tracer, disable,  # noqa: F401
+                     enable, get_tracer, joint_digest, span, tracing)
+
+
+def record_sections(flight_last: int = 64) -> dict:
+    """The observability sections every benchmark record embeds:
+    ``metrics`` (the registry JSON snapshot), ``flight`` (the tail of
+    the decision ring), and — when a tracer is installed —
+    ``trace_digest`` (host span self-times).  Small and JSON-ready."""
+    out = {"metrics": REGISTRY.snapshot(),
+           "flight": flight_dump(last=flight_last)}
+    t = get_tracer()
+    if t is not None:
+        out["trace_digest"] = t.digest()
+    return out

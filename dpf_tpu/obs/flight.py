@@ -1,0 +1,97 @@
+"""Flight recorder: a bounded ring of recent routing/fault decisions.
+
+The serving stack makes runtime decisions on every batch — cost-model
+routing, admission control, retries, breaker trips, supervisor
+rebuilds — and until now the only evidence was aggregate counter sums:
+a p99 regression or a mis-routed burst could not be attributed to a
+DECISION after the fact.  The flight recorder is the attribution
+substrate: every decision point appends one small structured event to
+a process-wide bounded ring, dumpable on demand (``flight_dump()``),
+embedded in benchmark records, and dumped automatically when the chaos
+bench's equality gate fails so an escape is diagnosable.
+
+Event kinds (full schema in docs/OBSERVABILITY.md):
+
+* ``route``    — construction, routed_from, bucket, batch, the cost
+  estimates the argmin saw, and (under fault injection) the arrival
+  index — the join key that attributes a later fault to the decision
+  that placed the batch.
+* ``shed`` / ``deadline`` — admission control rejections and
+  cooperative-deadline trips, with the queue state that triggered them.
+* ``breaker``  — every breaker state transition.
+* ``retry`` / ``failover`` — resilient-submit recovery steps.
+* ``fault``    — every injected-fault fire (kind, construction,
+  bucket, arrival), written by ``FaultInjector``.
+* ``rebuild``  — supervisor engine rebuilds (ok/failed).
+
+Events carry a monotonic timestamp relative to recorder start and a
+global sequence number, so interleavings across threads stay ordered.
+Recording is always on: one dict + deque append per DECISION (not per
+query), bounded memory, no I/O — the ``--trace`` bench's overhead leg
+measures the full observability stack under 2% of qps.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+#: bounded flight-ring capacity (events, not queries)
+FLIGHT_RING = 2048
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring; one process-wide instance
+    (``FLIGHT``) is the default everywhere."""
+
+    def __init__(self, capacity: int = FLIGHT_RING):
+        self._ring = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._t0 = time.monotonic()
+        self.recorded = 0           # total ever recorded (ring evicts)
+
+    def record(self, kind: str, **attrs) -> None:
+        """Append one event; never raises (decision paths call this)."""
+        try:
+            ev = {"seq": 0, "t": round(time.monotonic() - self._t0, 6),
+                  "kind": kind}
+            ev.update(attrs)
+            with self._lock:
+                self.recorded += 1
+                ev["seq"] = self.recorded
+                self._ring.append(ev)
+        except Exception:
+            pass
+
+    def dump(self, last: int | None = None) -> list:
+        """JSON-ready copy of the ring, oldest first (``last`` bounds
+        the tail for embedding in records)."""
+        with self._lock:
+            out = list(self._ring)
+        if last is not None:
+            out = out[-int(last):]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+        # `recorded` keeps counting: it is a monotonic metric
+
+    def export_jsonl(self, path: str) -> int:
+        events = self.dump()
+        with open(path, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        return len(events)
+
+
+#: the process flight recorder (serving code records into this)
+FLIGHT = FlightRecorder()
+
+
+def flight_dump(last: int | None = None) -> list:
+    """Dump the process flight ring (the on-demand diagnosis entry
+    point named by docs/OBSERVABILITY.md)."""
+    return FLIGHT.dump(last=last)

@@ -1,0 +1,295 @@
+"""Per-batch span tracing for the serving stack.
+
+``jax.profiler`` answers "where did DEVICE time go" (op-level tracks,
+``utils/profiling.summarize_trace``); nothing answered the same
+question for the HOST half of a served batch — the decode, the bucket
+pad, the admission wait, the blocking ``np.asarray`` — even though the
+engine's aggregate counters prove the host side dominates on the
+synchronous CPU backend.  This module is the host-side mirror: a
+lightweight ``Tracer`` producing NESTED spans (``submit`` > ``admit`` /
+``pack`` / ``dispatch``, ``wait``, ``decode``, plus the router's
+``route`` / ``retry`` / ``failover`` and the supervisor's ``rebuild``),
+carried through ``ServingEngine.submit``/``_resolve_one``,
+``SchemeRouter``, ``EngineSupervisor`` and ``LookupStream`` via the
+module-level ``span()`` helper.
+
+Design constraints (docs/OBSERVABILITY.md):
+
+* **Tracing-off fast path** — ``span()`` with no tracer installed
+  returns one shared no-op context manager: a single global read on the
+  serving hot path, no allocation.  The load harness's overhead leg
+  (``benchmark.py --trace``) measures the on/off qps delta and the
+  committed record keeps it under 2%.
+* **Bounded memory** — finished spans land in a ring
+  (``deque(maxlen=capacity)``); a long-lived serving process keeps the
+  most recent window, like the latency ring.
+* **Perfetto-ready export** — ``export_chrome()`` writes the Chrome
+  trace-event JSON Perfetto opens directly, so host spans sit alongside
+  a ``jax.profiler`` device trace of the same run; ``joint_digest``
+  merges the two into the one small digest benchmark records embed
+  (extending ``summarize_trace``'s ncu-report role to the host).
+
+Spans are thread-aware (one nesting stack per thread, thread id on
+every span), so supervisor rebuilds and background resolution show up
+on their own tracks.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from collections import deque
+
+#: default bounded span-ring capacity per tracer
+SPAN_RING = 8192
+
+
+class NullSpan:
+    """The shared no-op span: ``span()``'s answer when tracing is off.
+
+    Stateless and reentrant — one instance serves every call site
+    concurrently, so the off path costs a global read and nothing else.
+    """
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NULL_SPAN = NullSpan()
+
+
+class Span:
+    """One live span; use as a context manager (``Tracer.span``).
+
+    ``set(**attrs)`` attaches attributes any time before exit (e.g. the
+    routed construction, the bucket size).  On exit the span computes
+    its SELF time (duration minus direct children — the same
+    double-count subtraction ``summarize_trace`` applies to profiler
+    tracks) and lands in the tracer's ring.
+    """
+
+    __slots__ = ("name", "attrs", "span_id", "parent_id", "tid",
+                 "t0", "dur_s", "_children_s", "_tracer")
+
+    def __init__(self, tracer, name, span_id, parent_id, tid, attrs):
+        self._tracer = tracer
+        self.name = name
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.tid = tid
+        self.attrs = attrs
+        self.t0 = None
+        self.dur_s = 0.0
+        self._children_s = 0.0
+
+    def set(self, **attrs):
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        self._tracer._push(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.dur_s = time.perf_counter() - self.t0
+        if exc_type is not None:
+            self.attrs.setdefault("error", exc_type.__name__)
+        self._tracer._pop(self)
+        return False
+
+
+class Tracer:
+    """Bounded-ring span recorder; install process-wide via ``enable()``.
+
+    All methods are thread-safe; each thread keeps its own nesting
+    stack so concurrent submits/rebuilds produce correctly-parented
+    spans on separate tracks.
+    """
+
+    def __init__(self, capacity: int = SPAN_RING):
+        self._ring = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self._epoch = time.perf_counter()
+        self.dropped = 0          # spans evicted from the full ring
+        self.recorded = 0
+
+    # ------------------------------------------------------- recording
+
+    def span(self, name: str, **attrs) -> Span:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        parent = stack[-1].span_id if stack else None
+        return Span(self, name, next(self._ids), parent,
+                    threading.get_ident(), attrs)
+
+    def _push(self, sp: Span):
+        self._local.stack.append(sp)
+
+    def _pop(self, sp: Span):
+        stack = self._local.stack
+        # tolerate exotic unwinds: pop through to this span
+        while stack and stack[-1] is not sp:
+            stack.pop()
+        if stack:
+            stack.pop()
+        if stack:
+            stack[-1]._children_s += sp.dur_s
+        row = {"name": sp.name, "span_id": sp.span_id,
+               "parent_id": sp.parent_id, "tid": sp.tid,
+               "ts_us": round((sp.t0 - self._epoch) * 1e6, 1),
+               "dur_us": round(sp.dur_s * 1e6, 1),
+               "self_us": round(max(0.0, sp.dur_s - sp._children_s)
+                                * 1e6, 1)}
+        if sp.attrs:
+            row["attrs"] = sp.attrs
+        with self._lock:
+            if len(self._ring) == self._ring.maxlen:
+                self.dropped += 1
+            self._ring.append(row)
+            self.recorded += 1
+
+    # --------------------------------------------------------- reading
+
+    def events(self) -> list:
+        """Finished spans, oldest first (each a JSON-ready dict)."""
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+            self.dropped = 0
+            self.recorded = 0
+
+    def digest(self, top: int = 12) -> dict | None:
+        """Aggregate SELF time per span name — the host-side half of
+        the joint digest (mirrors ``summarize_trace``'s shape: small
+        enough to embed in a benchmark record)."""
+        events = self.events()
+        if not events:
+            return None
+        by_name = {}
+        total_us = 0.0
+        for e in events:
+            s = e["self_us"]
+            total_us += s
+            cnt, us = by_name.get(e["name"], (0, 0.0))
+            by_name[e["name"]] = (cnt + 1, us + s)
+        spans = sorted(by_name.items(), key=lambda kv: -kv[1][1])[:top]
+        return {"spans_recorded": self.recorded,
+                "spans_dropped": self.dropped,
+                "host_ms": round(total_us / 1e3, 3),
+                "top_spans": [{"span": k, "count": c,
+                               "ms": round(us / 1e3, 3)}
+                              for k, (c, us) in spans]}
+
+    # --------------------------------------------------------- exports
+
+    def export_jsonl(self, path: str) -> int:
+        """One span per line; returns the span count."""
+        events = self.events()
+        with open(path, "w") as f:
+            for e in events:
+                f.write(json.dumps(e) + "\n")
+        return len(events)
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON (``ph="X"`` complete events, µs
+        timestamps) — open in Perfetto (ui.perfetto.dev) next to the
+        ``jax.profiler`` device trace of the same run."""
+        events = [{"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+                   "args": {"name": "dpf_tpu host spans"}}]
+        tids = {}
+        for e in self.events():
+            tid = tids.setdefault(e["tid"], len(tids))
+            ev = {"ph": "X", "pid": 1, "tid": tid, "name": e["name"],
+                  "ts": e["ts_us"], "dur": e["dur_us"]}
+            if "attrs" in e:
+                ev["args"] = {k: str(v) for k, v in e["attrs"].items()}
+            events.append(ev)
+        for raw, tid in tids.items():
+            events.append({"ph": "M", "pid": 1, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": "host thread %d" % raw}})
+        return {"traceEvents": events,
+                "displayTimeUnit": "ms"}
+
+    def export_chrome(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+
+# ------------------------------------------------- process-wide tracer
+
+_TRACER: Tracer | None = None
+
+
+def enable(capacity: int = SPAN_RING) -> Tracer:
+    """Install (and return) the process tracer; idempotent unless a
+    different capacity is requested."""
+    global _TRACER
+    if _TRACER is None or _TRACER._ring.maxlen != int(capacity):
+        _TRACER = Tracer(capacity)
+    return _TRACER
+
+
+def disable() -> None:
+    """Remove the process tracer: ``span()`` reverts to the no-op fast
+    path (already-captured spans are dropped with the tracer)."""
+    global _TRACER
+    _TRACER = None
+
+
+def get_tracer() -> Tracer | None:
+    return _TRACER
+
+
+def tracing() -> bool:
+    return _TRACER is not None
+
+
+def span(name: str, **attrs):
+    """THE hot-path entry point: a real span when tracing is enabled,
+    the shared ``NULL_SPAN`` otherwise (one global read, no alloc)."""
+    t = _TRACER
+    if t is None:
+        return NULL_SPAN
+    return t.span(name, **attrs)
+
+
+# ---------------------------------------------------------- digesting
+
+def joint_digest(tracer: Tracer | None = None,
+                 trace_dir: str | None = None, top: int = 12) -> dict:
+    """The one digest benchmark records embed: host span self-times
+    (this module) merged with the device op self-times
+    (``utils.profiling.summarize_trace`` over a ``jax.profiler``
+    capture of the same run).  Either half may be absent (no tracer /
+    no profiler capture); ``total_ms`` sums whatever is present."""
+    host = None
+    t = tracer if tracer is not None else _TRACER
+    if t is not None:
+        host = t.digest(top=top)
+    device = None
+    if trace_dir:
+        from ..utils.profiling import summarize_trace
+        device = summarize_trace(trace_dir, top=top)
+    total = sum(d[k] for d, k in ((host, "host_ms"),
+                                  (device, "device_ms")) if d)
+    return {"host": host, "device": device,
+            "total_ms": round(total, 3)}

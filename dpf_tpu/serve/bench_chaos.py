@@ -43,6 +43,7 @@ import time
 import numpy as np
 
 from ..core.expand import DeadlineExceeded
+from ..obs import FLIGHT, flight_dump, record_sections
 from ..utils.profiling import swallowed_snapshot
 from .bench_load import _batch_for, _key_pool, _slo_stats, replay
 from .engine import LoadShed
@@ -254,6 +255,7 @@ def chaos_bench(n=4096, entry_size=16, cap=128, prf=0, *,
     ``--chaos`` record (``BENCH_CHAOS_r11.json``)."""
     from .router import LABELS, build_servers
 
+    FLIGHT.clear()      # scope the embedded flight tail to this bench
     table = np.random.default_rng(seed ^ 0xc4a05).integers(
         0, 2 ** 31, (n, entry_size), dtype=np.int32, endpoint=False)
     trace = loadgen.bursty_trace(
@@ -339,6 +341,17 @@ def chaos_bench(n=4096, entry_size=16, cap=128, prf=0, *,
             and chaos_leg["recovery"]["engine_restarts"] >= 1
             and victim_states[-1] == "closed"),
     }
+    record["obs"] = record_sections()
+    if not record["checked"]:
+        # a failed gate is exactly what the flight recorder exists to
+        # diagnose: embed the FULL ring (route decisions, breaker walk,
+        # every injected fault with its arrival join key)
+        record["obs"]["flight_on_gate_failure"] = flight_dump()
+        import sys
+        print("chaos gate FAILED — full flight dump embedded in record"
+              " (obs.flight_on_gate_failure, %d events)"
+              % len(record["obs"]["flight_on_gate_failure"]),
+              file=sys.stderr, flush=True)
     if not quiet:
         print(json.dumps(record), flush=True)
     return record

@@ -47,6 +47,8 @@ from collections import deque
 
 import numpy as np
 
+from ..obs import FLIGHT, record_sections
+from ..obs.tracer import span
 from ..utils.profiling import quantile
 from .engine import LoadShed, ServingEngine
 from . import loadgen
@@ -149,12 +151,13 @@ def _gate(done, pools, label_of) -> int:
     """Bit-exact equality of every served batch against the scalar-
     oracle reference rows; returns the rejection count."""
     rejections = 0
-    for a, j, fut in done:
-        label = label_of(fut)
-        _, refs = pools[label]
-        _, idxs = _batch_for(pools[label], j, a.batch)
-        if not np.array_equal(fut.result(), refs[idxs]):
-            rejections += 1
+    with span("gate", batches=len(done)):
+        for a, j, fut in done:
+            label = label_of(fut)
+            _, refs = pools[label]
+            _, idxs = _batch_for(pools[label], j, a.batch)
+            if not np.array_equal(fut.result(), refs[idxs]):
+                rejections += 1
     return rejections
 
 
@@ -166,6 +169,7 @@ def load_bench(n=4096, entry_size=16, cap=128, prf=0, *,
     seeded open-loop bursty trace; returns the ``--load`` record."""
     from .router import LABELS, SchemeRouter, resolve_sticky
 
+    FLIGHT.clear()      # scope the embedded flight tail to this bench
     table = np.random.default_rng(seed ^ 0x10ad).integers(
         0, 2 ** 31, (n, entry_size), dtype=np.int32, endpoint=False)
     if trace is None:
@@ -275,6 +279,7 @@ def load_bench(n=4096, entry_size=16, cap=128, prf=0, *,
 
     if shed_rec is not None:
         record["shed_leg"] = shed_rec
+    record["obs"] = record_sections()
     if not quiet:
         print(json.dumps(record), flush=True)
     return record

@@ -250,6 +250,8 @@ def pir_bench(points=None, *, prf=None, scheme=None, radix=None,
         "checked": True,  # every timed candidate passed the scalar-
         #                   oracle equality gates first
     }
+    from ..obs import record_sections
+    record["obs"] = record_sections()
     if not quiet:
         print(json.dumps(record), flush=True)
     if out:

@@ -38,6 +38,8 @@ from collections import deque
 import numpy as np
 
 from ..core.expand import DeadlineExceeded
+from ..obs.flight import FLIGHT
+from ..obs.tracer import span
 from ..utils.profiling import EngineCounters, note_swallowed
 from .buckets import Buckets
 
@@ -182,6 +184,11 @@ class ServingEngine:
         except Exception as e:  # cache must never break serving —
             # but the cause stays diagnosable (counter + one-shot warn)
             note_swallowed("serve.engine.compcache_enable", e, self.stats)
+        try:
+            from ..obs.metrics import register_engine
+            register_engine(self)
+        except Exception as e:  # observability must never break serving
+            note_swallowed("serve.engine.register_metrics", e, self.stats)
         if warmup:
             self.warmup()
 
@@ -202,83 +209,93 @@ class ServingEngine:
         self._check_deadline()
         t_enter = time.perf_counter()
         # pre-decoded packed batches (LookupStream) carry .batch
-        self._admit(getattr(keys, "batch", None) or len(keys))
-        t0 = time.perf_counter()
-        pk = self._server._decode_batch(keys)
-        b = pk.batch
-        fut = EngineFuture(self)
-        # the latency ring measures from submit ENTRY: a blocking
-        # admission wait is exactly the client-observed queueing the
-        # p99 SLO trigger exists to see (pack_time_s stays post-admit)
-        fut._t0 = t_enter
-        try:
-            for lo, hi in self.buckets.chunks(b):
-                self._check_deadline()
-                size = self.buckets.bucket_for(hi - lo)
-                padded = pk.slice(lo, hi).pad_to(size)
-                self.stats.pack_time_s += time.perf_counter() - t0
-                while len(self._queue) >= self.max_in_flight:
+        b_req = getattr(keys, "batch", None) or len(keys)
+        with span("submit", engine=self.label or "engine", batch=b_req):
+            with span("admit"):
+                self._admit(b_req)
+            t0 = time.perf_counter()
+            with span("pack", phase="decode"):
+                pk = self._server._decode_batch(keys)
+            b = pk.batch
+            fut = EngineFuture(self)
+            # the latency ring measures from submit ENTRY: a blocking
+            # admission wait is exactly the client-observed queueing the
+            # p99 SLO trigger exists to see (pack_time_s stays post-admit)
+            fut._t0 = t_enter
+            try:
+                for lo, hi in self.buckets.chunks(b):
                     self._check_deadline()
-                    self._resolve_one()
-                if self._injector is not None:
-                    # first-class injection point: may sleep (straggler),
-                    # raise InjectedDispatchError, or raise EngineDead —
-                    # the partial-unwind below handles either
-                    self._injector.on_dispatch(self, size)
-                t1 = time.perf_counter()
-                dev = self._server._dispatch_packed(padded)
-                self.stats.dispatch_time_s += time.perf_counter() - t1
-                part = _Part(dev, hi - lo, size)
-                fut._parts.append(part)
-                self._queue.append(part)
-                self.stats.note_dispatch(padded=size - (hi - lo),
-                                         in_flight=len(self._queue))
-                t0 = time.perf_counter()
-        except BaseException:
-            # Unwind a partially submitted batch: its dispatched parts
-            # must not stay orphaned in the window (the future is never
-            # returned), so block on each (never interrupt an in-flight
-            # program — relay safety) and drop it from the queue.
-            for p in fut._parts:
-                try:
-                    self._queue.remove(p)
-                except ValueError:
-                    pass
-                if p.dev is not None:
-                    np.asarray(p.dev)
-                    p.dev = None
-            raise
-        self.stats.batches_submitted += 1
-        self.stats.queries_submitted += b
-        self._pending.append(fut)
-        return fut
+                    size = self.buckets.bucket_for(hi - lo)
+                    with span("pack", phase="pad", bucket=size):
+                        padded = pk.slice(lo, hi).pad_to(size)
+                    self.stats.pack_time_s += time.perf_counter() - t0
+                    while len(self._queue) >= self.max_in_flight:
+                        self._check_deadline()
+                        self._resolve_one()
+                    with span("dispatch", bucket=size):
+                        if self._injector is not None:
+                            # first-class injection point: may sleep
+                            # (straggler), raise InjectedDispatchError, or
+                            # raise EngineDead — the partial-unwind below
+                            # handles either
+                            self._injector.on_dispatch(self, size)
+                        t1 = time.perf_counter()
+                        dev = self._server._dispatch_packed(padded)
+                        self.stats.dispatch_time_s += (time.perf_counter()
+                                                       - t1)
+                    part = _Part(dev, hi - lo, size)
+                    fut._parts.append(part)
+                    self._queue.append(part)
+                    self.stats.note_dispatch(padded=size - (hi - lo),
+                                             in_flight=len(self._queue))
+                    t0 = time.perf_counter()
+            except BaseException:
+                # Unwind a partially submitted batch: its dispatched parts
+                # must not stay orphaned in the window (the future is never
+                # returned), so block on each (never interrupt an in-flight
+                # program — relay safety) and drop it from the queue.
+                for p in fut._parts:
+                    try:
+                        self._queue.remove(p)
+                    except ValueError:
+                        pass
+                    if p.dev is not None:
+                        np.asarray(p.dev)
+                        p.dev = None
+                raise
+            self.stats.batches_submitted += 1
+            self.stats.queries_submitted += b
+            self._pending.append(fut)
+            return fut
 
     # ---------------------------------------------------------- resolution
 
     def _resolve_one(self):
         """Block on the oldest in-flight dispatch and store its rows."""
         part = self._queue.popleft()
-        t0 = time.perf_counter()
-        part.out = np.asarray(part.dev)[:part.n_real]
-        if self._injector is not None:
-            # injection point: corrupted-share faults replace the rows
-            # here, downstream of the device — the bit-gating oracle
-            # path must catch every one (integrity-check role)
-            part.out = self._injector.on_result(self, part.bucket,
-                                                part.out)
-        self.stats.wait_time_s += time.perf_counter() - t0
-        part.dev = None
+        with span("wait", bucket=part.bucket):
+            t0 = time.perf_counter()
+            part.out = np.asarray(part.dev)[:part.n_real]
+            if self._injector is not None:
+                # injection point: corrupted-share faults replace the rows
+                # here, downstream of the device — the bit-gating oracle
+                # path must catch every one (integrity-check role)
+                part.out = self._injector.on_result(self, part.bucket,
+                                                    part.out)
+            self.stats.wait_time_s += time.perf_counter() - t0
+            part.dev = None
 
     def _finalize(self, fut: EngineFuture):
-        parts = fut._parts
-        if len(parts) == 1:
-            out = parts[0].out
-        else:
-            out = np.concatenate([p.out for p in parts])
-        fut._value = np.ascontiguousarray(out[:, :self._out_width])
-        fut._parts = []
-        if fut._t0 is not None:
-            self.stats.note_latency(time.perf_counter() - fut._t0)
+        with span("decode", parts=len(fut._parts)):
+            parts = fut._parts
+            if len(parts) == 1:
+                out = parts[0].out
+            else:
+                out = np.concatenate([p.out for p in parts])
+            fut._value = np.ascontiguousarray(out[:, :self._out_width])
+            fut._parts = []
+            if fut._t0 is not None:
+                self.stats.note_latency(time.perf_counter() - fut._t0)
 
     def _resolve_through(self, fut: EngineFuture):
         """Resolve futures FIFO until (and including) ``fut``."""
@@ -416,6 +433,9 @@ class ServingEngine:
         # deadline spuriously nor starve it forever
         if self.deadline is not None and time.monotonic() > self.deadline:
             self.stats.deadline_misses += 1
+            FLIGHT.record("deadline", engine=self.label or "engine",
+                          pending=len(self._pending),
+                          in_flight=len(self._queue))
             raise DeadlineExceeded(
                 "serving-engine deadline passed between dispatches")
 
@@ -438,6 +458,12 @@ class ServingEngine:
         if self.shed and (over_depth or over_slo):
             self.stats.shed_batches += 1
             self.stats.shed_queries += n_queries
+            FLIGHT.record("shed", engine=self.label or "engine",
+                          batch=n_queries,
+                          reason=("queue_depth" if over_depth
+                                  else "p99_over_slo"),
+                          pending=len(self._pending),
+                          p99=self.stats.p99, slo_s=self.slo_s)
             raise LoadShed(
                 "admission control rejected the batch (%s; pending=%d, "
                 "p99=%s, slo_s=%s)"

@@ -51,6 +51,8 @@ import time
 import numpy as np
 
 from ..core.expand import DeadlineExceeded
+from ..obs.flight import FLIGHT
+from ..obs.tracer import span
 from .engine import LoadShed, ServingEngine
 
 #: fault kinds a FaultSpec can name
@@ -191,6 +193,12 @@ class FaultInjector:
             if (spec.kind in kinds and self._fires_left(idx, spec)
                     and spec.matches(label, bucket, self.arrival)
                     and self._decide(idx, spec)):
+                # flight-record every fire with the SAME arrival index
+                # the route decision carries — the join key that
+                # attributes a fault to the decision that placed it
+                FLIGHT.record("fault", fault=spec.kind,
+                              construction=label, bucket=bucket,
+                              arrival=self.arrival)
                 yield spec
 
     # ----------------------------------------------- injection points
@@ -305,7 +313,10 @@ def submit_with_retry(submit, policy: RetryPolicy, stats=None):
                     or attempt >= policy.max_attempts):
                 raise
             if stats is not None:
-                stats.retries += 1
+                if hasattr(stats, "inc"):
+                    stats.inc("retries")
+                else:
+                    stats.retries += 1
             policy.sleep(attempt)
 
 
@@ -329,11 +340,12 @@ class CircuitBreaker:
     STATES = ("closed", "open", "half_open")
 
     def __init__(self, failures: int = 3, reset_s: float = 30.0,
-                 on_open=None):
+                 on_open=None, name: str | None = None):
         if failures < 1:
             raise ValueError("failures must be >= 1 (got %d)" % failures)
         self.failures = int(failures)
         self.reset_s = float(reset_s)
+        self.name = name              # construction label (flight events)
         self.state = "closed"
         self.consecutive = 0
         self.opened_at = None
@@ -348,9 +360,13 @@ class CircuitBreaker:
         if state == "open":
             self.opened_at = time.monotonic()
             self.opens += 1
+        prev = self.state
         self.state = state
         self.transitions.append(
             (round(time.monotonic() - self._t0, 4), state))
+        FLIGHT.record("breaker", breaker=self.name or "breaker",
+                      frm=prev, to=state,
+                      consecutive_failures=self.consecutive)
         if state == "open" and self.on_open is not None:
             self.on_open(self)
 
@@ -440,17 +456,23 @@ class EngineSupervisor:
     def _rebuild(self, label: str) -> None:
         r = self._router
         try:
-            old = r.engines[label]
-            fresh = ServingEngine(r.server(label), buckets=r.buckets,
-                                  label=label, injector=r.injector,
-                                  **r._engine_kw)
-            fresh.warmup()            # re-warm BEFORE taking traffic
-            fresh.stats.merge(old.stats)
-            r.engines[label] = fresh
-            r.recovery.engine_restarts += 1
-        except Exception:
+            with span("rebuild", construction=label):
+                old = r.engines[label]
+                fresh = ServingEngine(r.server(label), buckets=r.buckets,
+                                      label=label, injector=r.injector,
+                                      **r._engine_kw)
+                fresh.warmup()        # re-warm BEFORE taking traffic
+                fresh.stats.merge(old.stats)
+                r.engines[label] = fresh
+            # inc(), not +=: rebuild threads race result() callers on
+            # the shared recovery counters
+            r.recovery.inc("engine_restarts")
+            FLIGHT.record("rebuild", construction=label, ok=True)
+        except Exception as e:
             with self._lock:
                 self.failed_rebuilds += 1
+            FLIGHT.record("rebuild", construction=label, ok=False,
+                          error=type(e).__name__)
         finally:
             with self._lock:
                 self._rebuilding.discard(label)

@@ -38,6 +38,8 @@ from __future__ import annotations
 import time
 
 from ..core.expand import DeadlineExceeded
+from ..obs.flight import FLIGHT
+from ..obs.tracer import span
 from ..utils.profiling import EngineCounters, note_swallowed
 from .buckets import Buckets
 from .engine import LoadShed, ServingEngine
@@ -261,11 +263,13 @@ class SchemeRouter:
         self.recovery = EngineCounters()
 
         def _opened(_lb=None):
-            self.recovery.breaker_opens += 1
+            # inc(), not +=: breakers trip from rebuild threads and
+            # RoutedFuture.result() callers concurrently
+            self.recovery.inc("breaker_opens")
         self.breakers = {
             lb: CircuitBreaker(failures=breaker_failures,
                                reset_s=breaker_reset_s,
-                               on_open=_opened)
+                               on_open=_opened, name=lb)
             for lb in labels}
         self.supervisor = (EngineSupervisor(self) if supervise
                            else None)
@@ -278,6 +282,12 @@ class SchemeRouter:
         self.routed_from = self.sticky_resolved_from
         self.route_counts = {lb: 0 for lb in labels}
         self.routed_from_counts = {}
+        try:
+            from ..obs.metrics import register_router
+            register_router(self)
+        except Exception as e:  # observability must never break serving
+            note_swallowed("serve.router.register_metrics", e,
+                           self.recovery)
         if warmup or probe:
             self.warmup(probe=probe, probe_reps=probe_reps)
 
@@ -406,40 +416,54 @@ class SchemeRouter:
         """
         if batch < 1:
             raise ValueError("batch must be >= 1 (got %d)" % batch)
-        bucket = (self.buckets.bucket_for(batch)
-                  if batch <= self.buckets.max else self.buckets.max)
-        avail = self._available(exclude)
-        costs = {lb: self._costs.get((lb, bucket)) for lb in avail}
-        if all(c is not None for c in costs.values()):
-            for lb in avail:
-                self._obs_age[(lb, bucket)] = (
-                    self._obs_age.get((lb, bucket), 0) + 1)
-            stalest = max(avail,
-                          key=lambda lb: self._obs_age[(lb, bucket)])
-            if self._obs_age[(stalest, bucket)] >= self.EXPLORE_EVERY:
-                label, routed_from = stalest, "explore"
-                # reset the clock at ROUTE time, not observation time:
-                # with deferred result() every in-flight route at this
-                # bucket would otherwise re-trigger the same explore —
-                # a window-sized storm of the possibly-slowest
-                # construction mid-burst
-                self._obs_age[(stalest, bucket)] = 0
+        with span("route", batch=batch):
+            bucket = (self.buckets.bucket_for(batch)
+                      if batch <= self.buckets.max else self.buckets.max)
+            avail = self._available(exclude)
+            costs = {lb: self._costs.get((lb, bucket)) for lb in avail}
+            if all(c is not None for c in costs.values()):
+                for lb in avail:
+                    self._obs_age[(lb, bucket)] = (
+                        self._obs_age.get((lb, bucket), 0) + 1)
+                stalest = max(avail,
+                              key=lambda lb: self._obs_age[(lb, bucket)])
+                if self._obs_age[(stalest, bucket)] >= self.EXPLORE_EVERY:
+                    label, routed_from = stalest, "explore"
+                    # reset the clock at ROUTE time, not observation
+                    # time: with deferred result() every in-flight route
+                    # at this bucket would otherwise re-trigger the same
+                    # explore — a window-sized storm of the
+                    # possibly-slowest construction mid-burst
+                    self._obs_age[(stalest, bucket)] = 0
+                else:
+                    label = min(costs, key=costs.get)
+                    routed_from = "cost-model"
+            elif self.sticky in avail:
+                label, routed_from = (self.sticky,
+                                      self.sticky_resolved_from)
             else:
-                label = min(costs, key=costs.get)
-                routed_from = "cost-model"
-        elif self.sticky in avail:
-            label, routed_from = self.sticky, self.sticky_resolved_from
-        else:
-            # sticky winner is down: cheapest available estimate, else
-            # first available — provenance says this was a failover
-            known = {lb: c for lb, c in costs.items() if c is not None}
-            label = (min(known, key=known.get) if known else avail[0])
-            routed_from = "failover"
-        self.routed_from = routed_from
-        self.route_counts[label] += 1
-        self.routed_from_counts[routed_from] = (
-            self.routed_from_counts.get(routed_from, 0) + 1)
-        return RouteDecision(label, routed_from, bucket, batch)
+                # sticky winner is down: cheapest available estimate,
+                # else first available — provenance says failover
+                known = {lb: c for lb, c in costs.items()
+                         if c is not None}
+                label = (min(known, key=known.get) if known
+                         else avail[0])
+                routed_from = "failover"
+            self.routed_from = routed_from
+            self.route_counts[label] += 1
+            self.routed_from_counts[routed_from] = (
+                self.routed_from_counts.get(routed_from, 0) + 1)
+            ev = {"construction": label, "routed_from": routed_from,
+                  "bucket": bucket, "batch": batch,
+                  "costs_ms": {lb: (None if c is None
+                                    else round(c * 1e3, 4))
+                               for lb, c in costs.items()}}
+            if self.injector is not None:
+                # the arrival index FaultInjector events carry too —
+                # the join key for fault -> route attribution
+                ev["arrival"] = self.injector.arrival
+            FLIGHT.record("route", **ev)
+            return RouteDecision(label, routed_from, bucket, batch)
 
     def submit(self, decision: RouteDecision, keys) -> RoutedFuture:
         """Dispatch ``keys`` (minted for ``decision.construction`` —
@@ -485,19 +509,36 @@ class SchemeRouter:
         while True:
             attempt += 1
             decision = self.route(batch, exclude=excluded)
-            if (last_label is not None
-                    and decision.construction != last_label):
-                self.recovery.failovers += 1
+            failed_over = (last_label is not None
+                           and decision.construction != last_label)
+            if failed_over:
+                self.recovery.inc("failovers")
+                FLIGHT.record("failover", frm=last_label,
+                              to=decision.construction, batch=batch,
+                              attempt=attempt)
             last_label = decision.construction
             try:
-                return self.submit(decision, keys_for(decision.construction))
+                if attempt == 1:
+                    return self.submit(decision,
+                                       keys_for(decision.construction))
+                # re-attempts get their own span ("failover" when the
+                # construction changed) so recovery time is attributable
+                with span("failover" if failed_over else "retry",
+                          attempt=attempt,
+                          construction=decision.construction):
+                    return self.submit(decision,
+                                       keys_for(decision.construction))
             except (LoadShed, DeadlineExceeded):
                 raise
             except Exception as e:
                 if (not policy.retryable(e)
                         or attempt >= policy.max_attempts):
                     raise
-                self.recovery.retries += 1
+                self.recovery.inc("retries")
+                FLIGHT.record("retry",
+                              construction=decision.construction,
+                              batch=batch, attempt=attempt,
+                              error=type(e).__name__)
                 if isinstance(e, EngineDead):
                     # dead engines don't heal within a backoff window:
                     # fail over NOW, no sleep

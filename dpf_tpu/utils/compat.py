@@ -37,6 +37,16 @@ def has_tpu_interpret_mode() -> bool:
     return hasattr(pltpu, "force_tpu_interpret_mode")
 
 
+def has_effects_barrier() -> bool:
+    """True when ``jax.effects_barrier()`` exists (jax >= 0.4.x late
+    line).  ``utils.profiling.Timer`` uses it to drain ALL in-flight
+    async dispatches at exit; the legacy fallback — blocking on a fresh
+    ``jnp.zeros(())`` — only proves one new dispatch completed, which
+    on TPU leaves prior independent work un-drained."""
+    import jax
+    return callable(getattr(jax, "effects_barrier", None))
+
+
 def has_cpu_multiprocess() -> bool:
     """True when the CPU backend supports multi-process computations
     (cross-process collectives).  jaxlib 0.4.x's CPU client raises

@@ -1,0 +1,333 @@
+"""Observability stack (dpf_tpu/obs/, docs/OBSERVABILITY.md): span
+tracer nesting/ring/exports, the metrics registry's OpenMetrics
+rendering and weakref collector pruning, the flight recorder ring, and
+the serving engine's span wiring end to end."""
+
+import gc
+import json
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from dpf_tpu.obs import tracer as obs_tracer
+from dpf_tpu.obs.flight import FLIGHT, FlightRecorder, flight_dump
+from dpf_tpu.obs.metrics import (MetricsRegistry, register_engine,
+                                 register_router)
+from dpf_tpu.obs.tracer import NULL_SPAN, Tracer, joint_digest, span
+
+
+@pytest.fixture(autouse=True)
+def _tracing_off():
+    """Every test leaves the process tracer the way it found it: off."""
+    yield
+    obs_tracer.disable()
+
+
+# ---------------------------------------------------------------- tracer
+
+def test_span_is_noop_when_disabled():
+    obs_tracer.disable()
+    assert not obs_tracer.tracing()
+    s = span("submit", batch=4)
+    assert s is NULL_SPAN                 # shared instance, no alloc
+    with s as sp:
+        assert sp.set(bucket=8) is sp     # set() chains on the no-op too
+
+
+def test_enable_records_disable_reverts():
+    t = obs_tracer.enable()
+    assert obs_tracer.tracing() and obs_tracer.get_tracer() is t
+    assert obs_tracer.enable() is t       # idempotent at same capacity
+    with span("submit", batch=4):
+        pass
+    assert t.events()[-1]["name"] == "submit"
+    assert t.events()[-1]["attrs"] == {"batch": 4}
+    obs_tracer.disable()
+    assert span("submit") is NULL_SPAN
+
+
+def test_nested_spans_parenting_and_self_time():
+    t = Tracer()
+    with t.span("outer") as outer:
+        time.sleep(0.002)
+        with t.span("inner") as inner:
+            time.sleep(0.002)
+    evs = {e["name"]: e for e in t.events()}
+    assert evs["inner"]["parent_id"] == outer.span_id
+    assert evs["outer"]["parent_id"] is None
+    assert inner.parent_id == outer.span_id
+    # self time = duration minus direct children (same subtraction
+    # summarize_trace applies to profiler tracks); 0.1 us rounding
+    assert evs["outer"]["self_us"] == pytest.approx(
+        evs["outer"]["dur_us"] - evs["inner"]["dur_us"], abs=0.5)
+    assert evs["inner"]["self_us"] == evs["inner"]["dur_us"]
+
+
+def test_ring_bounded_drop_accounting_and_clear():
+    t = Tracer(capacity=4)
+    for i in range(6):
+        with t.span("s%d" % i):
+            pass
+    assert len(t.events()) == 4
+    assert [e["name"] for e in t.events()] == ["s2", "s3", "s4", "s5"]
+    assert t.recorded == 6 and t.dropped == 2
+    t.clear()
+    assert t.events() == [] and t.recorded == 0 and t.dropped == 0
+
+
+def test_span_records_exception_class():
+    t = Tracer()
+    with pytest.raises(ValueError):
+        with t.span("boom"):
+            raise ValueError("nope")
+    assert t.events()[-1]["attrs"]["error"] == "ValueError"
+
+
+def test_digest_aggregates_self_time_per_name():
+    t = Tracer()
+    for _ in range(3):
+        with t.span("submit"):
+            with t.span("pack"):
+                pass
+    d = t.digest()
+    assert d["spans_recorded"] == 6 and d["spans_dropped"] == 0
+    by = {s["span"]: s for s in d["top_spans"]}
+    assert by["submit"]["count"] == 3 and by["pack"]["count"] == 3
+    assert d["host_ms"] >= 0
+    assert Tracer().digest() is None      # empty tracer digests to None
+
+
+def test_threads_get_their_own_nesting_stacks():
+    t = Tracer()
+
+    def other():
+        with t.span("worker"):
+            pass
+    with t.span("main"):
+        th = threading.Thread(target=other)
+        th.start()
+        th.join()
+    evs = {e["name"]: e for e in t.events()}
+    # the worker span must NOT be parented under "main" (other thread)
+    assert evs["worker"]["parent_id"] is None
+    assert evs["worker"]["tid"] != evs["main"]["tid"]
+
+
+def test_exports_jsonl_and_chrome(tmp_path):
+    t = Tracer()
+    with t.span("submit", batch=4):
+        with t.span("dispatch", bucket=8):
+            pass
+    p = tmp_path / "spans.jsonl"
+    assert t.export_jsonl(str(p)) == 2
+    lines = [json.loads(ln) for ln in p.read_text().splitlines()]
+    assert [ln["name"] for ln in lines] == ["dispatch", "submit"]
+    doc = t.chrome_trace()
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    metas = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert {e["name"] for e in xs} == {"submit", "dispatch"}
+    assert all("ts" in e and "dur" in e and e["pid"] == 1 for e in xs)
+    assert any(m["name"] == "thread_name" for m in metas)
+    cp = tmp_path / "spans.chrome.json"
+    t.export_chrome(str(cp))
+    json.loads(cp.read_text())            # Perfetto-loadable JSON
+
+
+def test_joint_digest_host_only_and_empty():
+    t = Tracer()
+    with t.span("submit"):
+        pass
+    d = joint_digest(tracer=t)
+    assert d["device"] is None
+    assert d["host"]["spans_recorded"] == 1
+    assert d["total_ms"] == d["host"]["host_ms"]
+    assert joint_digest(tracer=Tracer()) == {
+        "host": None, "device": None, "total_ms": 0}
+
+
+class _Fake:
+    """Attribute bag that supports weak references (register_engine /
+    register_router hold their subject weakly; SimpleNamespace cannot
+    be weak-referenced)."""
+
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+# --------------------------------------------------------------- metrics
+
+def test_counter_gauge_basics_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("req", "requests")
+    c.inc()
+    c.labels(construction="logn").inc(2)
+    assert c.value == 1
+    assert c.labels(construction="logn").value == 2
+    with pytest.raises(ValueError):
+        c.inc(-1)                         # counters only go up
+    g = reg.gauge("depth")
+    g.set(3)
+    g.inc()
+    assert g.value == 4.0
+    assert reg.counter("req") is c        # create-or-return by name
+    with pytest.raises(ValueError):
+        reg.gauge("req")                  # one meaning per name
+
+
+def test_histogram_buckets_cumulative_and_fold():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    rows = h.samples()
+    by = {extra: v for _, _, extra, v in rows}
+    assert by[(("le", "0.1"),)] == 1      # cumulative le-bucket counts
+    assert by[(("le", "1"),)] == 2
+    assert by[(("le", "+Inf"),)] == 3
+    assert by[()] in (3, 5.55)            # _sum and _count rows
+    h.observe_counts([1, 0, 0], 0.05, 1)  # fold pre-aggregated counts
+    assert h.samples()[0][3] == 2         # le=0.1 now cumulative 2
+
+
+def test_openmetrics_text_format():
+    reg = MetricsRegistry()
+    reg.counter("dpf_x", "help text").labels(k="v").inc(2)
+    reg.gauge("dpf_y").set(1.5)
+    text = reg.openmetrics()
+    assert "# HELP dpf_x help text" in text
+    assert "# TYPE dpf_x counter" in text
+    assert 'dpf_x_total{k="v"} 2' in text
+    assert "# TYPE dpf_y gauge" in text
+    assert "dpf_y 1.5" in text
+    assert text.endswith("# EOF\n")
+
+
+def test_snapshot_is_json_ready():
+    reg = MetricsRegistry()
+    reg.counter("c").inc()
+    reg.histogram("h", buckets=(1.0,)).observe(0.5)
+    snap = json.loads(json.dumps(reg.snapshot()))
+    assert snap["c"]["kind"] == "counter"
+    assert snap["h"]["series"]["()"]["count"] == 1
+
+
+def test_weakref_collector_prunes_on_gc():
+    reg = MetricsRegistry()
+
+    class Obj:
+        pass
+    obj = Obj()
+    reg.watch(obj, lambda o: [("dpf_live", "gauge", "", {}, 1.0)])
+    assert "dpf_live 1" in reg.openmetrics()
+    del obj
+    gc.collect()
+    assert "dpf_live" not in reg.openmetrics()
+    assert reg._collectors == []          # pruned, not just skipped
+
+
+def test_broken_collector_never_breaks_the_scrape():
+    from dpf_tpu.utils.profiling import swallowed_snapshot
+    reg = MetricsRegistry()
+    reg.counter("dpf_ok").inc()
+    reg.register_collector(lambda: 1 / 0)
+    with pytest.warns(RuntimeWarning):
+        text = reg.openmetrics()
+    assert "dpf_ok_total 1" in text
+    assert "ZeroDivisionError" in str(
+        swallowed_snapshot().get("obs.metrics.collector", {}))
+
+
+def test_register_engine_exports_counters_and_histogram():
+    from dpf_tpu.utils.profiling import EngineCounters
+    reg = MetricsRegistry()
+    stats = EngineCounters(batches_submitted=3, queries_submitted=40)
+    stats.note_latency(0.003)
+    eng = _Fake(label="e1", stats=stats)
+    register_engine(eng, reg)
+    text = reg.openmetrics()
+    assert 'dpf_engine_batches_submitted_total{engine="e1"} 3' in text
+    assert 'dpf_engine_latency_p50_seconds{engine="e1"}' in text
+    assert ('dpf_engine_latency_seconds_bucket{engine="e1",le="0.005"} 1'
+            in text)
+    assert 'dpf_engine_latency_seconds_count{engine="e1"} 1' in text
+
+
+def test_register_router_exports_breaker_and_cost_series():
+    reg = MetricsRegistry()
+    rt = _Fake(
+        breakers={"logn": SimpleNamespace(state="open", opens=2)},
+        _costs={("logn", 16): 0.001},
+        route_counts={"logn": 3},
+        routed_from_counts={"cost-model": 3})
+    register_router(rt, reg)
+    text = reg.openmetrics()
+    assert 'dpf_breaker_state{construction="logn"} 1' in text
+    assert 'dpf_breaker_opens_total{construction="logn"} 2' in text
+    assert ('dpf_router_cost_seconds{bucket="16",construction="logn"} '
+            '0.001' in text)
+    assert 'dpf_router_routes_total{construction="logn"} 3' in text
+    assert 'dpf_router_routed_from_total{source="cost-model"} 3' in text
+
+
+# ---------------------------------------------------------------- flight
+
+def test_flight_ring_seq_dump_and_clear(tmp_path):
+    fr = FlightRecorder(capacity=4)
+    for i in range(6):
+        fr.record("route", construction="logn", arrival=i)
+    evs = fr.dump()
+    assert len(evs) == 4 and fr.recorded == 6
+    assert [e["seq"] for e in evs] == [3, 4, 5, 6]  # oldest first
+    assert [e["arrival"] for e in fr.dump(last=2)] == [4, 5]
+    assert all(e["t"] >= 0 for e in evs)
+    p = tmp_path / "flight.jsonl"
+    assert fr.export_jsonl(str(p)) == 4
+    assert json.loads(p.read_text().splitlines()[-1])["seq"] == 6
+    fr.clear()
+    assert fr.dump() == [] and fr.recorded == 6  # monotonic metric
+
+
+def test_flight_record_never_raises():
+    fr = FlightRecorder(capacity=2)
+    fr.record("weird", payload=object())  # non-JSON attr still records
+    assert fr.dump()[-1]["kind"] == "weird"
+
+
+def test_global_flight_dump_tail():
+    mark = FLIGHT.recorded
+    FLIGHT.record("shed", reason="test", batch=9)
+    tail = flight_dump(last=1)
+    assert tail[-1]["kind"] == "shed" and tail[-1]["seq"] == mark + 1
+
+
+# ----------------------------------------------- engine wiring (e2e)
+
+def test_engine_emits_spans_and_registers_metrics():
+    from dpf_tpu import DPF
+    from dpf_tpu.obs.metrics import REGISTRY
+    dpf = DPF(prf=DPF.PRF_DUMMY)
+    table = np.random.default_rng(3).integers(
+        0, 2 ** 31, (256, 7), dtype=np.int64).astype(np.int32)
+    dpf.eval_init(table)
+    keys = [dpf.gen((i * 31) % 256, 256)[0] for i in range(6)]
+    engine = dpf.serving_engine(buckets=(4, 8), max_in_flight=2)
+    t = obs_tracer.enable()
+    t.clear()
+    futs = [engine.submit(keys[:b]) for b in (1, 3, 6)]
+    engine.drain()
+    for b, fut in zip((1, 3, 6), futs):
+        ref = np.asarray(dpf.eval_tpu(keys[:b]))
+        assert np.array_equal(fut.result(), ref)
+    names = {e["name"] for e in t.events()}
+    assert {"submit", "admit", "pack", "dispatch",
+            "wait", "decode"} <= names
+    subs = [e for e in t.events() if e["name"] == "submit"]
+    assert [e["attrs"]["batch"] for e in subs] == [1, 3, 6]
+    # children of submit are parented under it (host-side flame graph)
+    packs = [e for e in t.events() if e["name"] == "pack"]
+    assert all(e["parent_id"] is not None for e in packs)
+    # the engine self-registered: its series are scrapeable
+    assert "dpf_engine_batches_submitted_total" in REGISTRY.openmetrics()

@@ -7,6 +7,7 @@ runs on hardware."""
 import os
 
 import numpy as np
+import pytest
 
 import dpf_tpu
 from dpf_tpu.utils.profiling import Timer, summarize_trace, trace
@@ -37,10 +38,102 @@ def test_summarize_trace_missing_dir(tmp_path):
     assert summarize_trace(str(tmp_path / "nope")) is None
 
 
+# --------------------------- summarize_trace vs the committed fixture
+#
+# tests/fixtures/obs_synthetic.trace.json is a hand-built Chrome trace:
+# one "XLA Ops" track with a nested op tree (fusion.outer spans two
+# dot.fused rows, one of which spans convert.inner) plus a 5 ms host
+# track.  Exact self-times are known, so the digest's nesting
+# subtraction and track selection are checked against ground truth
+# instead of whatever the live profiler happens to emit.
+
+_FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                        "obs_synthetic.trace.json")
+
+
+def _gz_fixture(tmp_path, rename=None):
+    """Pack the committed fixture into the <dir>/**/*.trace.json.gz
+    layout the profiler writes (optionally renaming thread tracks to
+    exercise the selection fallbacks)."""
+    import gzip
+    import json
+    with open(_FIXTURE) as f:
+        doc = json.load(f)
+    for e in doc["traceEvents"]:
+        if rename and e.get("ph") == "M" and e["name"] == "thread_name":
+            e["args"]["name"] = rename.get(e["args"]["name"],
+                                           e["args"]["name"])
+    d = tmp_path / "plugins" / "profile"
+    d.mkdir(parents=True)
+    with gzip.open(str(d / "host.trace.json.gz"), "wt") as f:
+        json.dump(doc, f)
+    return str(tmp_path)
+
+
+def test_summarize_fixture_picks_xla_ops_and_subtracts_nesting(tmp_path):
+    s = summarize_trace(_gz_fixture(tmp_path))
+    assert s["tracks"] == "xla_ops"
+    assert s["device_ms"] == 0.1          # 100 us: host track excluded
+    ops = {o["op"]: o["ms"] for o in s["top_ops"]}
+    # fusion.outer 100 - 40 - 20 = 40; dot.fused (40-10) + 20 = 50
+    assert ops == {"dot.fused": 0.05, "fusion.outer": 0.04,
+                   "convert.inner": 0.01}
+    assert s["top_ops"][0]["op"] == "dot.fused"  # sorted by self time
+    assert "host_blocking_wait" not in ops
+
+
+def test_summarize_fixture_tf_xla_fallback(tmp_path):
+    s = summarize_trace(_gz_fixture(
+        tmp_path, rename={"/device:TPU:0 XLA Ops": "tf_XLA_execute"}))
+    assert s["tracks"] == "tf_xla"
+    assert s["device_ms"] == 0.1          # same tree, same self-times
+
+
+def test_summarize_fixture_unknown_tracks_include_host(tmp_path):
+    s = summarize_trace(_gz_fixture(
+        tmp_path, rename={"/device:TPU:0 XLA Ops": "worker-0"}))
+    assert s["tracks"] == "all_tracks_incl_host"  # tagged, not silent
+    assert s["device_ms"] == 5.1          # host 5 ms + device 0.1 ms
+    assert s["top_ops"][0] == {"op": "host_blocking_wait", "ms": 5.0}
+
+
+# ----------------------------------------------------------------- Timer
+
 def test_timer_blocks_on_device():
     with Timer() as t:
         pass
     assert t.elapsed >= 0
+
+
+def test_timer_exit_uses_effects_barrier(monkeypatch):
+    import jax
+
+    from dpf_tpu.utils import compat
+    assert compat.has_effects_barrier()   # pinned jax 0.4.37 has it
+    called = []
+    monkeypatch.setattr(jax, "effects_barrier",
+                        lambda: called.append(True))
+    with Timer():
+        pass
+    assert called == [True]
+
+
+def test_timer_exit_fallback_blocks_on_noted_outputs(monkeypatch):
+    import jax
+
+    from dpf_tpu.utils import compat
+    monkeypatch.setattr(compat, "has_effects_barrier", lambda: False)
+    blocked = []
+    monkeypatch.setattr(jax, "block_until_ready",
+                        lambda x: blocked.append(x) or x)
+    a, b = object(), object()
+    with Timer(a).note(b):                # outputs via ctor AND note()
+        pass
+    assert blocked == [[a, b]]
+    blocked.clear()
+    with Timer():                         # no outputs: legacy zeros sync
+        pass
+    assert len(blocked) == 1 and not isinstance(blocked[0], list)
 
 
 # ------------------------------------------------------- EngineCounters
@@ -142,3 +235,79 @@ def test_counters_as_dict_rounds_all_floats_generically():
             assert v == round(v, 6)
     assert d["pack_time_s"] == 0.123457
     assert "latency_ms" not in d          # empty ring -> no quantiles
+
+
+def test_counters_latency_histogram_accumulates_and_merges():
+    from dpf_tpu.utils.profiling import (LATENCY_HIST_BUCKETS_S,
+                                         EngineCounters)
+    a, b = EngineCounters(), EngineCounters()
+    a.note_latency(0.003)                 # le=0.005 bucket
+    a.note_latency(0.02)                  # le=0.025
+    b.note_latency(0.003)
+    b.note_latency(99.0)                  # +Inf bucket
+    h = a.merge(b).latency_histogram()
+    assert h["buckets"] == list(LATENCY_HIST_BUCKETS_S)
+    assert h["count"] == 4 and h["sum"] == pytest.approx(99.026)
+    assert h["counts"][LATENCY_HIST_BUCKETS_S.index(0.005)] == 2
+    assert h["counts"][LATENCY_HIST_BUCKETS_S.index(0.025)] == 1
+    assert h["counts"][-1] == 1           # +Inf
+    # the histogram accumulates while the ring forgets: reset drops both
+    a.reset()
+    assert a.latency_histogram()["count"] == 0
+
+
+def test_counters_inc_and_notes_are_thread_safe():
+    import threading
+
+    from dpf_tpu.utils.profiling import EngineCounters
+    c = EngineCounters()
+
+    def work():
+        for _ in range(1000):
+            c.inc("retries")
+            c.note_latency(0.001)
+            c.note_dispatch(padded=1, in_flight=2)
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.retries == 8000              # no lost += updates
+    assert c.dispatches == 8000 and c.padded_queries == 8000
+    assert c.latency_histogram()["count"] == 8000
+
+
+def test_note_swallowed_is_thread_safe_and_feeds_stats():
+    import threading
+    import warnings
+
+    from dpf_tpu.utils.profiling import (EngineCounters, note_swallowed,
+                                         swallowed_snapshot)
+    site = "test.profiling.swallow-race"
+    stats = EngineCounters()
+    # absorb the once-per-(site, cls) warning in the main thread first
+    # (warnings.catch_warnings mutates global state, so the worker
+    # threads must not race through it)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        note_swallowed(site, ValueError("x"), stats)
+
+    def work():
+        for _ in range(500):
+            note_swallowed(site, ValueError("x"), stats)
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert swallowed_snapshot()[site] == {"ValueError": 4001}
+    assert stats.swallowed_errors == 4001
+
+
+def test_cache_counters_reset():
+    from dpf_tpu.utils.profiling import CacheCounters
+    c = CacheCounters(tuning_hits=2, compile_misses=5,
+                      compile_time_saved_s=1.5)
+    assert c.reset() is c
+    assert c == CacheCounters()
+    assert c.as_dict()["compile_time_saved_s"] == 0.0
