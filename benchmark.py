@@ -7,7 +7,7 @@
 #   (default sweep)    utils/bench.test_dpf_perf    BENCH_r0*.json
 #   --serve            serve/bench_serve.py         BENCH_SERVE_r06.json
 #   --autotune         tune/search.autotune_sweep   BENCH_TUNE_r07.json
-#   --autotune-scheme  tune/search.scheme_sweep     BENCH_SCHEME_r08.json
+#   --autotune-scheme  tune/search.scheme_sweep     BENCH_SCHEME_r13.json
 #   --batch-pir        serve/bench_pir.py           BENCH_PIR_r09.json
 #   --multichip        serve/bench_multichip.py     MULTICHIP_r06.json
 #   --load             serve/bench_load.py          BENCH_LOAD_r10.json

@@ -516,10 +516,14 @@ class DPF(object):
         the AES pair impl, ``ROUND_UNROLL``) are re-read every call so
         ``set_dot_impl``/``apply_globals`` stay live between dispatches.
 
-        scheme='sqrtn' resolves its own two-knob space (``dot_impl``,
-        ``row_chunk``) under the same precedence; ``row_chunk`` may
-        come back None — the dispatch path resolves it against the
-        decoded batch's key split (``sqrtn.clamp_row_chunk``).
+        scheme='sqrtn' resolves its own knob space (``dot_impl``,
+        ``row_chunk``, ``kernel_impl``) under the same precedence,
+        plus ``kernel_resolved_from`` provenance ("config" | "tuned" |
+        "heuristic" | "degraded" — the last when a resolved "pallas"
+        has no Pallas/TPU here and the xla scan answers instead);
+        ``row_chunk`` may come back None — the dispatch path resolves
+        it against the decoded batch's key split
+        (``sqrtn.clamp_row_chunk``).
         """
         from .core import prf as _prf
         from .ops import matmul128
@@ -531,7 +535,8 @@ class DPF(object):
         tuned = self._tuned_cache.get(batch)
         if tuned is None:
             if self.scheme == "sqrtn":
-                auto_fields = ((cfg.row_chunk, cfg.dot_impl)
+                auto_fields = ((cfg.row_chunk, cfg.dot_impl,
+                                cfg.kernel_impl)
                                if cfg is not None else (None,))
             else:
                 auto_fields = ((cfg.chunk_leaves, cfg.dot_impl,
@@ -556,14 +561,46 @@ class DPF(object):
             return v if v is not None else fallback
 
         if self.scheme == "sqrtn":
-            # the sqrtn program has exactly two knobs; row_chunk's
-            # heuristic needs the key split (K, R), which only the
-            # decoded batch knows — a None here is resolved at dispatch
-            # by sqrtn.clamp_row_chunk, which also re-checks tuned
-            # values against the live-slab budget
+            # row_chunk's heuristic needs the key split (K, R), which
+            # only the decoded batch knows — a None here is resolved at
+            # dispatch by sqrtn.clamp_row_chunk, which also re-checks
+            # tuned values against the live-slab budget.  kernel_impl
+            # resolves with provenance: an unavailable Pallas host
+            # degrades a tuned/pinned "pallas" to the xla scan instead
+            # of raising (kernel_resolved_from="degraded", counted via
+            # note_swallowed) so a tuning cache written on a TPU stays
+            # usable on this machine
+            explicit_k = cfg.kernel_impl if cfg is not None else None
+            if not is_auto(explicit_k):
+                kernel, kernel_from = explicit_k, "config"
+            elif tuned.get("kernel_impl") is not None:
+                kernel, kernel_from = tuned["kernel_impl"], "tuned"
+            else:
+                kernel, kernel_from = "xla", "heuristic"
+            if kernel == "pallas":
+                from .utils.compat import has_pallas_sqrt_kernel
+                if not has_pallas_sqrt_kernel():
+                    from .utils.profiling import note_swallowed
+                    note_swallowed(
+                        "api.sqrt_kernel_unavailable",
+                        RuntimeError(
+                            "kernel_impl='pallas' (from %s) but Pallas/"
+                            "TPU is unavailable here" % kernel_from))
+                    kernel, kernel_from = "xla", "degraded"
+            row_chunk = pick("row_chunk", None)
+            if (row_chunk is not None
+                    and (cfg is None or is_auto(cfg.row_chunk))
+                    and tuned.get("kernel_impl", "xla") != kernel):
+                # the tuner gated (row_chunk, kernel) together — a
+                # tuned row_chunk rides only with ITS kernel (the logn
+                # chunk_leaves rule); the winning kernel falls back to
+                # its own heuristic/VMEM clamp at dispatch
+                row_chunk = None
             return {
                 "dot_impl": pick("dot_impl", matmul128.default_impl()),
-                "row_chunk": pick("row_chunk", None),
+                "row_chunk": row_chunk,
+                "kernel_impl": kernel,
+                "kernel_resolved_from": kernel_from,
             }
 
         kernel_impl = pick("kernel_impl", "xla")
@@ -642,7 +679,15 @@ class DPF(object):
         (``sqrtn.clamp_row_chunk`` — tuned entries key on the table
         shape, not the split), while an EXPLICIT ``EvalConfig.row_chunk``
         passes straight through so an invalid pin raises rather than
-        silently measuring the heuristic (the logn chunk_leaves rule)."""
+        silently measuring the heuristic (the logn chunk_leaves rule).
+
+        ``kernel_impl`` comes resolved (with availability degradation)
+        from ``resolved_eval_knobs``; what remains here is the
+        SHAPE-level gate only the decoded batch can answer — the grid
+        kernel needs a supported prf core and, for the block-PRG ids,
+        R % 4 == 0 (``pallas_sqrt.pallas_sqrt_unsupported``).  An
+        unsupported shape degrades to the xla scan with the same
+        note_swallowed provenance rather than raising."""
         from .core import sqrtn
         from .utils.config import is_auto
         kn = self.resolved_eval_knobs(pk.batch)
@@ -653,10 +698,20 @@ class DPF(object):
         else:
             rc = sqrtn.clamp_row_chunk(kn["row_chunk"], pk.n_codewords,
                                        pk.n_keys, pk.batch)
+        kernel = kn.get("kernel_impl", "xla")
+        if kernel == "pallas":
+            from .ops.pallas_sqrt import pallas_sqrt_unsupported
+            reason = pallas_sqrt_unsupported(self.prf_method,
+                                             pk.n_codewords)
+            if reason is not None:
+                from .utils.profiling import note_swallowed
+                note_swallowed("api.sqrt_kernel_unsupported",
+                               ValueError(reason))
+                kernel = "xla"
         return sqrtn.eval_contract_batched(
             pk.seeds, pk.cw1, pk.cw2, self.table_device,
             prf_method=self.prf_method, dot_impl=kn["dot_impl"],
-            row_chunk=rc)
+            row_chunk=rc, kernel_impl=kernel)
 
     def _mixed_batch(self, keys):
         """Deserialize + validate a radix-4 key batch (uniform n)."""

@@ -490,11 +490,19 @@ class PrivateLookupServer:
                     mesh=mesh_tag(self.mesh)) or {}
             self._tuned[key] = tuned
         if sch == "sqrtn":
+            rc = tuned.get("row_chunk")
+            if tuned.get("kernel_impl", "xla") != "xla":
+                # a tuned row_chunk rides only with ITS kernel (the
+                # logn chunk_leaves rule below): the per-key-tables
+                # program is always the fused xla scan, so a grid-
+                # kernel winner's VMEM-capped chunk must not be pinned
+                # onto it — fall back to the scan's own heuristic
+                rc = None
             return {"dot_impl": tuned.get("dot_impl")
                     or matmul128.default_impl(),
                     # clamped against the decoded batch's split at
                     # dispatch (sqrtn.clamp_row_chunk)
-                    "row_chunk": tuned.get("row_chunk")}
+                    "row_chunk": rc}
         chunk = tuned.get("chunk_leaves")
         if tuned.get("kernel_impl", "xla") != "xla":
             # a tuned chunk rides only with ITS kernel; the

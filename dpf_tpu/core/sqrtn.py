@@ -538,7 +538,8 @@ def _resolve_row_chunk(r: int, k: int, bsz: int,
 
 def eval_contract_batched(seeds, cw1, cw2, table, *, prf_method: int,
                           dot_impl: str = "i32",
-                          row_chunk: int | None = None):
+                          row_chunk: int | None = None,
+                          kernel_impl: str | None = "xla"):
     """Fused batched sqrt-N evaluation: one device program for the whole
     batch — row-chunked [B, rc, K] PRF grid slabs scanned over the R
     rows, LSB codeword select, 128-bit add, exact mod-2^32 contraction
@@ -551,12 +552,26 @@ def eval_contract_batched(seeds, cw1, cw2, table, *, prf_method: int,
     — be a multiple of 4, so the block-PRG 4-row interleave in
     ``_grid_vals`` stays intact.
 
+    ``kernel_impl`` picks the program: ``"xla"`` (default) is the scan
+    path below — kept verbatim as the bit-exactness oracle — and
+    ``"pallas"`` routes to the fused VMEM-resident grid kernel
+    (``ops/pallas_sqrt.py``; ``row_chunk`` then obeys the kernel's
+    VMEM cell cap and ``dot_impl`` is moot — the in-kernel contraction
+    is the exact int32 dot).  This layer does NOT probe availability:
+    ``api.resolved_eval_knobs`` gates and degrades with provenance,
+    mirroring the logn ``expand_and_contract`` split.
+
     This is the production sqrt-N path (``eval_contract`` keeps the
     per-key stacking for reference use): no level loop, no permutation —
     the latency-friendly construction for mid-sized tables (the role the
     reference's coop kernel plays for single queries,
     ``dpf_gpu/dpf_coop.cu:3-9``).
     """
+    if (kernel_impl or "xla") == "pallas":
+        from ..ops import pallas_sqrt
+        return pallas_sqrt.sqrt_grid_contract_pallas(
+            seeds, cw1, cw2, table, prf_method=prf_method,
+            row_chunk=row_chunk)
     bsz, k = seeds.shape[0], seeds.shape[1]
     r = cw1.shape[1]
     row_chunk = _resolve_row_chunk(r, k, bsz, row_chunk)
@@ -641,9 +656,10 @@ def eval_contract_per_key_tables(seeds, cw1, cw2, tables, *,
 
 @functools.partial(jax.jit, static_argnames=("prf_method", "dot_impl",
                                              "row_chunk", "psum_group",
-                                             "mesh"))
+                                             "mesh", "kernel_impl"))
 def _eval_sharded_sqrt_jit(seeds, cw1, cw2, table, *, prf_method,
-                           dot_impl, row_chunk, psum_group, mesh):
+                           dot_impl, row_chunk, psum_group, mesh,
+                           kernel_impl="xla"):
     from jax.sharding import PartitionSpec as P
 
     from ..ops import matmul128
@@ -668,6 +684,16 @@ def _eval_sharded_sqrt_jit(seeds, cw1, cw2, table, *, prf_method,
                                           r_local, axis=1)
         c2 = jax.lax.dynamic_slice_in_dim(cw2_l, shard_ix * r_local,
                                           r_local, axis=1)
+        if (kernel_impl or "xla") == "pallas":
+            # the fused grid kernel accumulates its own row tiles in
+            # VMEM with the TRACED per-shard row base, so the local
+            # scan (and psum_group pipelining) collapses to one kernel
+            # dispatch + one terminal psum
+            from ..ops import pallas_sqrt
+            return jax.lax.psum(
+                pallas_sqrt._sqrt_grid_contract_impl(
+                    seeds_l, c1, c2, tbl, row0_base,
+                    prf_method=prf_method, row_chunk=rc), "table")
         sel = (seeds_l[:, None, :, 0] & np.uint32(1)).astype(bool)[..., None]
 
         def contract(row0, c1_c, c2_c, tc):
@@ -717,7 +743,8 @@ def _eval_sharded_sqrt_jit(seeds, cw1, cw2, table, *, prf_method,
 def eval_sharded_sqrt(seeds, cw1, cw2, table, *, prf_method: int,
                       mesh, dot_impl: str = "i32",
                       row_chunk: int | None = None,
-                      psum_group: int | None = None):
+                      psum_group: int | None = None,
+                      kernel_impl: str | None = "xla"):
     """Mesh-parallel fused sqrt-N evaluation: the [R, K] grid row-sharded
     over the "table" mesh axis, keys over "batch".
 
@@ -738,6 +765,14 @@ def eval_sharded_sqrt(seeds, cw1, cw2, table, *, prf_method: int,
     ``psum_group`` = scan steps accumulated locally between psums
     (0/None = one terminal psum): smaller groups start collectives
     earlier so ICI latency overlaps the next chunk's PRF expansion.
+    ``kernel_impl="pallas"`` swaps each shard's local scan for the
+    fused VMEM-resident grid kernel (``ops/pallas_sqrt.py``) with this
+    shard's traced ``row0`` base; the kernel accumulates its own row
+    tiles, so ``psum_group`` is moot (one terminal psum) and
+    ``row_chunk`` additionally obeys the kernel's VMEM cell cap.
+    Availability is the CALLER's job (``api.resolved_eval_knobs`` /
+    ``ShardedDPFServer.resolved_eval_knobs`` degrade with provenance);
+    an unsupported shape here raises.
     Returns [B, E] int32, sharded over "batch", replicated over "table".
     """
     bsz, k = seeds.shape[0], seeds.shape[1]
@@ -757,10 +792,16 @@ def eval_sharded_sqrt(seeds, cw1, cw2, table, *, prf_method: int,
             "straddle a shard boundary) — use fewer table shards or a "
             "wider n_keys split" % r_local)
     row_chunk = _resolve_row_chunk(r_local, k, bsz, row_chunk)
+    if (kernel_impl or "xla") == "pallas":
+        from ..ops.pallas_sqrt import pallas_sqrt_unsupported
+        reason = pallas_sqrt_unsupported(prf_method, r_local)
+        if reason:
+            raise ValueError(reason)
     return _eval_sharded_sqrt_jit(
         jnp.asarray(seeds), jnp.asarray(cw1), jnp.asarray(cw2), table,
         prf_method=prf_method, dot_impl=dot_impl, row_chunk=row_chunk,
-        psum_group=int(psum_group or 0), mesh=mesh)
+        psum_group=int(psum_group or 0), mesh=mesh,
+        kernel_impl=(kernel_impl or "xla"))
 
 
 # ------------------------------------------------------ point evaluation

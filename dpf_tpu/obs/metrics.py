@@ -429,10 +429,19 @@ def register_router(router, registry: MetricsRegistry | None = None):
             out.append(("dpf_breaker_opens", "counter",
                         "closed->open transitions",
                         {"construction": lb}, float(br.opens)))
+        kern_of = getattr(r, "dispatch_kernel", None)
         for (lb, bucket), s in sorted(r._costs.items()):
+            labels = {"construction": lb, "bucket": bucket}
+            if callable(kern_of):
+                # label the estimate with the kernel the construction
+                # would dispatch at this bucket (sqrtn: "xla" scan vs
+                # "pallas" grid kernel) so a cost-table shift is
+                # attributable to kernel selection
+                kern = kern_of(lb, bucket)
+                if kern is not None:
+                    labels["kernel"] = kern
             out.append(("dpf_router_cost_seconds", "gauge",
-                        "EWMA per-dispatch cost estimate",
-                        {"construction": lb, "bucket": bucket}, s))
+                        "EWMA per-dispatch cost estimate", labels, s))
         for lb, c in r.route_counts.items():
             out.append(("dpf_router_routes", "counter",
                         "batches routed per construction",

@@ -65,6 +65,16 @@ def _rotl(x, b):
     return (x << np.uint32(b)) | (x >> np.uint32(32 - b))
 
 
+def _pos_plane(zero, pos_word):
+    """The cipher state's position word as a plane.  Scalar positions
+    (the GGM child index) stay a hard-coded u32 constant; array
+    positions (the sqrt-N grid kernel's per-cell row counters,
+    ``ops/pallas_sqrt.py``) broadcast against the zero plane."""
+    if isinstance(pos_word, (int, np.integer)):
+        return zero + np.uint32(pos_word)
+    return zero + pos_word
+
+
 def _chacha_block_planes(s, pos_word):
     """ChaCha20-12 full block on 4 seed planes -> 16 output words.
 
@@ -81,7 +91,7 @@ def _chacha_block_planes(s, pos_word):
     x = [zero + np.uint32(_SIGMA[i]) for i in range(4)]
     x += [s[3], s[2], s[1], s[0]]
     x += [zero] * 4
-    x += [zero, zero + np.uint32(pos_word), zero, zero]
+    x += [zero, _pos_plane(zero, pos_word), zero, zero]
     init = jnp.stack(x)
 
     def double_round(_, st):
@@ -121,7 +131,7 @@ def _salsa_block_planes(s, pos_word):
     x[10] = zero + np.uint32(_SIGMA[2])
     x[15] = zero + np.uint32(_SIGMA[3])
     x[1], x[2], x[3], x[4] = s[3], s[2], s[1], s[0]
-    x[9] = zero + np.uint32(pos_word)
+    x[9] = _pos_plane(zero, pos_word)
     init = jnp.stack(x)
 
     def double_round(_, st):

@@ -1,0 +1,228 @@
+"""Fused sqrt-N PRF-grid -> contract Pallas TPU kernel.
+
+The XLA sqrt-N path (``core/sqrtn._eval_contract_batched_jit``) scans
+``[B, rc, K]`` PRF grid slabs through HBM: every scan step materializes
+the slab, applies the LSB codeword select/add, and hands ``matmul128``
+a ``[B, rc*K]`` leaf-share tensor — at ChaCha's ~25 int-ops/byte that
+slab traffic is comparable to the compute.  This module supplies the
+fused alternative (the sqrt-N half of the ROADMAP megakernel item,
+completing ``pallas_level.subtree_contract_pallas``'s logn half):
+
+grid ``(B/TB, R/rc)`` — for each key tile, one ``rc``-row tile of the
+``[R, K]`` PRF grid is expanded **entirely in VMEM** (one cipher call
+over the ``[TB, rc*K]`` cell planes; the block-PRG ids evaluate one
+512-bit core block per FOUR grid rows and interleave, exactly
+``sqrtn._grid_vals``), the low-limb codeword select/add lands in
+registers, and the ``[TB, E]`` table contraction accumulates in the
+VMEM-resident output block (the documented reduction-dim pattern: the
+innermost grid dimension does not appear in the output index map).  The
+one-hot leaf share never touches HBM.
+
+Cell order is natural: cell ``m = t*K + c`` of a tile holds grid row
+``row0 + t``, column seed ``c`` — table rows line up with no
+permutation, and a traced ``row0`` (the sharded path's per-shard row
+base) rides in as a tiny ``[steps, 1]`` VMEM operand.
+
+Only the low 32 output bits are contracted, and 128-bit adds carry
+upward only, so the codeword add needs just the low limb — the kernel
+ships ``cw*[..., 0]`` planes and skips the carry chain entirely.
+
+Correctness: asserted against the scan-path oracle in tests (interpret
+mode on CPU, compiled on TPU).  ChaCha20-12/Salsa20-12 cores and their
+block-PRG variants; AES stays on the XLA path (see
+``pallas_level``'s module docstring).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .pallas_level import _BLK_CORES, _CORES, _compiler_params
+
+# default tile knobs: widest live state = 16 cipher words x [TB, cells]
+# u32 (the block-PRG ids quarter that — one block per 4 rows)
+PALLAS_SQRT_TB = 32         # key tile (sublane-friendly multiple of 8)
+PALLAS_SQRT_MAX_CELLS = 2048  # rc*K per tile -> ~4 MB cipher state
+
+
+def pallas_sqrt_unsupported(prf_method: int, r: int) -> str | None:
+    """Why the grid kernel cannot run this shape (None = it can).
+
+    Callers that resolved ``kernel_impl="pallas"`` degrade to the xla
+    scan path with provenance (``note_swallowed``) instead of raising —
+    only an EXPLICIT pallas pin surfaces the reason as an error."""
+    if prf_method not in _CORES and prf_method not in _BLK_CORES:
+        return ("prf id %d has no Pallas plane core (AES stays on the "
+                "XLA dispatch path)" % prf_method)
+    if prf_method in _BLK_CORES and r % 4:
+        return ("block-PRG sqrt-N grid kernel needs R (%d) to be a "
+                "multiple of 4 (the 4-rows-per-core-block interleave "
+                "cannot straddle a tile edge)" % r)
+    return None
+
+
+def pallas_sqrt_row_chunk(r: int, k: int,
+                          row_chunk: int | None = None) -> int:
+    """Grid rows per kernel step.  The kernel's live state is the
+    ``[TB, rc*K]`` cipher planes in VMEM, so the bound is the CELL count
+    (``PALLAS_SQRT_MAX_CELLS``), not the XLA scan's 64 MiB HBM slab.
+    Explicit/tuned values obey the shared row-chunk rules (divide R,
+    multiple of 4 when chunking — ``sqrtn._resolve_row_chunk``) and are
+    then silently halved down to the cell cap: the accumulation order
+    changes, the bits do not (int32 adds wrap)."""
+    from ..core.sqrtn import ROW_CHUNK_FLOOR, _resolve_row_chunk
+    rc = r if row_chunk is None else _resolve_row_chunk(r, k, 1, row_chunk)
+    # halving preserves "divides R"; the %8 guard keeps rc a multiple
+    # of 4 all the way down to the 4-row interleave floor
+    while rc * k > PALLAS_SQRT_MAX_CELLS and rc > ROW_CHUNK_FLOOR \
+            and rc % 8 == 0:
+        rc //= 2
+    return rc
+
+
+def _make_sqrt_kernel(prf_method: int, tb: int, rc: int, k: int):
+    """Kernel body for one (key tile, row tile) grid step."""
+    from jax.experimental import pallas as pl
+
+    blk = _BLK_CORES.get(prf_method)
+    core = None if blk is not None else _CORES[prf_method]
+    cells = rc * k
+
+    def kernel(row0_ref, seeds_ref, cw1_ref, cw2_ref, table_ref, out_ref):
+        j = pl.program_id(1)
+        row0 = row0_ref[0, 0]                          # this tile's base row
+        s = [seeds_ref[i] for i in range(4)]           # [TB, K]
+        # cell m = t*K + c: grid row row0+t under column seed c —
+        # natural order, matching the table tile rows directly
+        if blk is not None:
+            # ONE core block per 4 grid rows: counter plane c for rows
+            # 4c..4c+3 (row0 is a multiple of 4 by the row-chunk rules)
+            nctr = rc // 4
+            planes = [jnp.broadcast_to(p[:, None, :], (tb, nctr, k))
+                      .reshape(tb, nctr * k) for p in s]
+            ctr = ((row0 >> np.uint32(2))
+                   + lax.broadcasted_iota(jnp.uint32, (tb, nctr, k), 1)
+                   .reshape(tb, nctr * k))
+            out16 = blk(planes, ctr)
+            # row 4c+g = block words [4g..4g+3] MSW-first, so the low
+            # limb is word 4g+3 (``_grid_vals``/``_blk_group``)
+            val0 = jnp.stack([out16[4 * g + 3].reshape(tb, nctr, k)
+                              for g in range(4)],
+                             axis=2).reshape(tb, cells)
+        else:
+            planes = [jnp.broadcast_to(p[:, None, :], (tb, rc, k))
+                      .reshape(tb, cells) for p in s]
+            pos = (row0 + lax.broadcasted_iota(jnp.uint32, (tb, rc, k), 1)
+                   .reshape(tb, cells))
+            val0 = core(planes, pos)[0]
+        sel = (s[0] & np.uint32(1)).astype(jnp.bool_)  # [TB, K]
+        cw_lo = jnp.where(
+            jnp.broadcast_to(sel[:, None, :], (tb, rc, k))
+            .reshape(tb, cells),
+            jnp.broadcast_to(cw2_ref[:][:, :, None], (tb, rc, k))
+            .reshape(tb, cells),
+            jnp.broadcast_to(cw1_ref[:][:, :, None], (tb, rc, k))
+            .reshape(tb, cells))
+        leaves = (val0 + cw_lo).astype(jnp.int32)      # [TB, cells]
+        contrib = lax.dot_general(
+            leaves, table_ref[:], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.int32)          # x [E, cells]
+
+        @pl.when(j == 0)
+        def _():
+            out_ref[:] = contrib
+
+        @pl.when(j > 0)
+        def _():
+            out_ref[:] = out_ref[:] + contrib
+
+    return kernel
+
+
+def _sqrt_grid_contract_impl(seeds, cw1, cw2, table, row0, *,
+                             prf_method: int, row_chunk: int | None = None,
+                             interpret=False, tb: int | None = None):
+    """Traceable launcher (the sharded per-shard body calls this inside
+    its own jit/shard_map with a TRACED ``row0``).
+
+    seeds: [B, K, 4] u32; cw1/cw2: [B, R, 4] u32; table: [R*K, E] int32
+    natural-order rows for grid rows row0..row0+R-1.  Returns [B, E]
+    int32 shares, bit-identical to the scan oracle.
+    """
+    from jax.experimental import pallas as pl
+
+    bsz, k, _ = seeds.shape
+    r = cw1.shape[1]
+    e = table.shape[1]
+    assert table.shape[0] == r * k, (table.shape, r, k)
+    reason = pallas_sqrt_unsupported(prf_method, r)
+    if reason:
+        raise ValueError(reason)
+    rc = pallas_sqrt_row_chunk(r, k, row_chunk)
+    steps = r // rc
+
+    tb = tb or min(PALLAS_SQRT_TB, max(8, bsz))
+    pb = (-bsz) % tb
+    if pb:
+        seeds = jnp.pad(seeds, ((0, pb), (0, 0), (0, 0)))
+        cw1 = jnp.pad(cw1, ((0, pb), (0, 0), (0, 0)))
+        cw2 = jnp.pad(cw2, ((0, pb), (0, 0), (0, 0)))
+    bp = bsz + pb
+
+    sm = jnp.transpose(seeds, (2, 0, 1))               # [4, B, K]
+    cw1_lo = cw1[:, :, 0]                              # [B, R] low limbs
+    cw2_lo = cw2[:, :, 0]
+    table_t = table.T                                  # [E, R*K]
+    row0s = (jnp.asarray(row0, jnp.uint32)
+             + jnp.arange(steps, dtype=jnp.uint32)
+             * jnp.uint32(rc))[:, None]                # [steps, 1]
+
+    grid = (bp // tb, steps)
+    kernel = _make_sqrt_kernel(prf_method, tb, rc, k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1), lambda i, j: (j, 0)),
+            pl.BlockSpec((4, tb, k), lambda i, j: (0, i, 0)),
+            pl.BlockSpec((tb, rc), lambda i, j: (i, j)),
+            pl.BlockSpec((tb, rc), lambda i, j: (i, j)),
+            pl.BlockSpec((e, rc * k), lambda i, j: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((tb, e), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bp, e), jnp.int32),
+        interpret=interpret,
+        # key tiles are independent; the row-tile axis accumulates into
+        # the same [tb, E] output block (reduction dim -> "arbitrary")
+        compiler_params=_compiler_params(("parallel", "arbitrary")),
+    )(row0s, sm, cw1_lo, cw2_lo, table_t)
+    return out[:bsz]
+
+
+_sqrt_grid_contract_jit = functools.partial(
+    jax.jit, static_argnames=("prf_method", "row_chunk", "interpret",
+                              "tb"))(_sqrt_grid_contract_impl)
+
+
+def sqrt_grid_contract_pallas(seeds, cw1, cw2, table, *, prf_method: int,
+                              row_chunk: int | None = None, row0=0,
+                              interpret=False, tb: int | None = None):
+    """Jit-wrapped fused sqrt-N grid kernel; ``interpret=True`` runs
+    EAGERLY (see ``pallas_level.chacha_level_step_pallas`` —
+    interpret-under-jit compile blows up super-linearly on XLA-CPU).
+
+    ``row0`` may be a traced uint32 scalar (the sharded path's
+    per-shard row base); already-traced callers get the impl inlined.
+    """
+    args = (jnp.asarray(seeds), jnp.asarray(cw1), jnp.asarray(cw2),
+            jnp.asarray(table), row0)
+    fn = (_sqrt_grid_contract_impl if interpret
+          else _sqrt_grid_contract_jit)
+    return fn(*args, prf_method=prf_method, row_chunk=row_chunk,
+              interpret=interpret, tb=tb)

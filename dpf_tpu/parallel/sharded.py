@@ -338,7 +338,8 @@ class ShardedDPFServer:
                  scheme: str = "logn", chunk_leaves: int | None = None,
                  row_chunk: int | None = None,
                  psum_group: int | None = None,
-                 dot_impl: str | None = None):
+                 dot_impl: str | None = None,
+                 kernel_impl: str | None = None):
         from ..core import keygen  # local import to avoid cycles
         from ..utils.config import check_construction
         self._keygen = keygen
@@ -378,6 +379,7 @@ class ShardedDPFServer:
         self.row_chunk = row_chunk
         self.psum_group = psum_group
         self.dot_impl = dot_impl
+        self.kernel_impl = kernel_impl  # sqrtn: "xla" | "pallas" | None
         self._tuned_memo = {}  # batch -> (mesh-tuned, single-tuned) dicts
 
     def _resolve_auto_scheme(self, batch_size: int, prf_method: int):
@@ -435,8 +437,9 @@ class ShardedDPFServer:
         explicit = {"chunk_leaves": self.chunk,
                     "row_chunk": self.row_chunk,
                     "psum_group": self.psum_group,
-                    "dot_impl": self.dot_impl}
-        fields = (("row_chunk", "psum_group", "dot_impl")
+                    "dot_impl": self.dot_impl,
+                    "kernel_impl": self.kernel_impl}
+        fields = (("row_chunk", "psum_group", "dot_impl", "kernel_impl")
                   if self.scheme == "sqrtn"
                   else ("chunk_leaves", "psum_group", "dot_impl"))
         if all(explicit[f] is not None for f in fields):
@@ -472,6 +475,37 @@ class ShardedDPFServer:
                "dot_impl": pick("dot_impl", matmul128.default_impl())}
         if self.scheme == "sqrtn":
             out["row_chunk"] = pick("row_chunk")
+            # kernel_impl with provenance, the DPF rule: explicit >
+            # tuned > "xla"; a resolved "pallas" without Pallas/TPU
+            # here degrades to the xla scan instead of raising
+            if explicit["kernel_impl"] is not None:
+                kernel, kernel_from = explicit["kernel_impl"], "config"
+            elif tuned.get("kernel_impl",
+                           single.get("kernel_impl")) is not None:
+                kernel = tuned.get("kernel_impl",
+                                   single.get("kernel_impl"))
+                kernel_from = "tuned"
+            else:
+                kernel, kernel_from = "xla", "heuristic"
+            if kernel == "pallas":
+                from ..utils.compat import has_pallas_sqrt_kernel
+                if not has_pallas_sqrt_kernel():
+                    from ..utils.profiling import note_swallowed
+                    note_swallowed(
+                        "sharded.sqrt_kernel_unavailable",
+                        RuntimeError(
+                            "kernel_impl='pallas' (from %s) but Pallas/"
+                            "TPU is unavailable here" % kernel_from))
+                    kernel, kernel_from = "xla", "degraded"
+            if (out["row_chunk"] is not None
+                    and explicit["row_chunk"] is None
+                    and (tuned.get("kernel_impl",
+                                   single.get("kernel_impl", "xla"))
+                         or "xla") != kernel):
+                # a tuned row_chunk rides only with ITS kernel
+                out["row_chunk"] = None
+            out["kernel_impl"] = kernel
+            out["kernel_resolved_from"] = kernel_from
             return out
         if explicit["chunk_leaves"] is not None:
             out["chunk_leaves"] = min(int(explicit["chunk_leaves"]),
@@ -509,11 +543,24 @@ class ShardedDPFServer:
                 # the heuristic (the DPF dispatch rule)
                 rc = sqrtn.clamp_row_chunk(
                     rc, pk.n_codewords // n_shards, pk.n_keys, pk.batch)
+            kernel = kn.get("kernel_impl", "xla")
+            if kernel == "pallas":
+                # the shape-level gate only the decoded batch answers:
+                # per-SHARD rows must fit the grid kernel (blk prf ids
+                # need R/shards % 4 == 0); degrade with provenance
+                from ..ops.pallas_sqrt import pallas_sqrt_unsupported
+                reason = pallas_sqrt_unsupported(
+                    self.prf_method, pk.n_codewords // n_shards)
+                if reason is not None:
+                    from ..utils.profiling import note_swallowed
+                    note_swallowed("sharded.sqrt_kernel_unsupported",
+                                   ValueError(reason))
+                    kernel = "xla"
             return sqrtn.eval_sharded_sqrt(
                 pk.seeds, pk.cw1, pk.cw2, self.table_sharded,
                 prf_method=self.prf_method, mesh=self.mesh,
                 dot_impl=kn["dot_impl"], row_chunk=rc,
-                psum_group=kn["psum_group"])
+                psum_group=kn["psum_group"], kernel_impl=kernel)
         if self.radix == 4:
             return eval_sharded_mixed(
                 pk.cw1, pk.cw2, pk.last, self.table_sharded, n=self.n,

@@ -331,6 +331,11 @@ class ServingEngine:
         discarded and none of the serving counters move.  (Sqrt-N keys
         minted with a custom ``n_keys`` split compile their own program
         on first dispatch — only the default split is prewarmed.)
+        Because each dispatch goes through ``resolved_eval_knobs``, the
+        precompiled program is whatever kernel the resolver picks —
+        for sqrtn that includes ``kernel_impl`` ("xla" scan or the
+        fused "pallas" grid kernel), so real traffic hits a warm cache
+        for the same kernel it will actually run.
 
         ``tune=True`` first re-tunes the serving knobs in place: the
         persistent tuning cache (``tune/cache.py``) is consulted for

@@ -394,6 +394,25 @@ class SchemeRouter:
     def _note_success(self, lb: str) -> None:
         self.breakers[lb].record_success()
 
+    def dispatch_kernel(self, lb: str, bucket: int) -> str | None:
+        """The per-dispatch ``kernel_impl`` the construction's server
+        would resolve at this bucket (None when the server doesn't
+        expose its resolution — or for the logn schemes before their
+        resolver learns the field).  Recorded on route events and as a
+        label on the EWMA cost-table metrics series so a relay-TPU
+        ``--load`` run can attribute latency shifts to kernel
+        selection.  Cheap: ``resolved_eval_knobs`` memoizes its tuning
+        lookup per batch size."""
+        try:
+            eng = self.engines.get(lb)
+            rk = getattr(getattr(eng, "_server", None),
+                         "resolved_eval_knobs", None)
+            if callable(rk):
+                return rk(bucket).get("kernel_impl")
+        except Exception as e:  # diagnostics must never break routing
+            note_swallowed("serve.router.dispatch_kernel", e)
+        return None
+
     def route(self, batch: int, exclude=()) -> RouteDecision:
         """Pick the construction for a ``batch``-query arrival.
 
@@ -455,6 +474,9 @@ class SchemeRouter:
                 self.routed_from_counts.get(routed_from, 0) + 1)
             ev = {"construction": label, "routed_from": routed_from,
                   "bucket": bucket, "batch": batch,
+                  # the winning construction's per-dispatch kernel
+                  # decision — fault/latency attribution joins on it
+                  "kernel_impl": self.dispatch_kernel(label, bucket),
                   "costs_ms": {lb: (None if c is None
                                     else round(c * 1e3, 4))
                                for lb, c in costs.items()}}

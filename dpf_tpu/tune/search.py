@@ -42,9 +42,11 @@ from .fingerprint import cache_key, device_fingerprint
 STAGES = ("chunk_leaves", "dot_impl", "kernel_impl", "dispatch_group",
           "aes_impl")
 
-#: the sqrt-N program has exactly two knobs: the scan's row chunk (its
-#: memory shape) and the contraction backend
-SQRT_STAGES = ("row_chunk", "dot_impl")
+#: the sqrt-N stage order: the scan's row chunk (its memory shape),
+#: the contraction backend, then the program structure — "xla" (the
+#: chunked scan) vs "pallas" (the fused VMEM-resident grid kernel,
+#: ops/pallas_sqrt.py; TPU only)
+SQRT_STAGES = ("row_chunk", "dot_impl", "kernel_impl")
 
 
 def heuristic_knobs(n: int, batch: int, *, prf_method: int,
@@ -57,6 +59,7 @@ def heuristic_knobs(n: int, batch: int, *, prf_method: int,
         return {
             "row_chunk": sqrtn.choose_row_chunk(r, k, batch),
             "dot_impl": matmul128.default_impl(),
+            "kernel_impl": "xla",
         }
     return {
         "chunk_leaves": expand.choose_chunk(n, batch),
@@ -98,6 +101,16 @@ def stage_candidates(stage: str, current: dict, *, n: int, batch: int,
     if stage == "dot_impl":
         return list(matmul128.available_impls())
     if stage == "kernel_impl":
+        if "row_chunk" in current:  # the sqrtn grid-kernel space
+            from ..core import sqrtn
+            from ..ops.pallas_sqrt import pallas_sqrt_unsupported
+            from ..utils.compat import has_pallas_sqrt_kernel
+            out = ["xla"]
+            k, r = sqrtn.default_split(n)
+            if (has_pallas_sqrt_kernel(backend)
+                    and pallas_sqrt_unsupported(prf_method, r) is None):
+                out.append("pallas")
+            return out
         out = ["xla", "dispatch"]
         if backend == "tpu":
             out.append("pallas")
@@ -256,7 +269,14 @@ def tune_eval(n: int, batch: int, *, entry_size: int = 16,
 
 def _knob_tag(knobs: dict) -> str:
     if "row_chunk" in knobs:  # the sqrtn knob space
-        return "rc%s.%s" % (knobs.get("row_chunk"), knobs.get("dot_impl"))
+        tag = "rc%s.%s" % (knobs.get("row_chunk"), knobs.get("dot_impl"))
+        kern = knobs.get("kernel_impl")
+        if kern not in (None, "xla"):
+            # backward-compatible grammar growth: the xla scan keeps
+            # the pre-kernel "rc%s.%s" spelling, so old tuning.json
+            # entries (no kernel_impl field) still read as "xla"
+            tag += ".%s" % kern
+        return tag
     return "c%s.%s.%s.g%s.%s" % (
         knobs.get("chunk_leaves"), knobs.get("dot_impl"),
         knobs.get("kernel_impl"), knobs.get("dispatch_group"),

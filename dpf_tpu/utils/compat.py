@@ -37,6 +37,28 @@ def has_tpu_interpret_mode() -> bool:
     return hasattr(pltpu, "force_tpu_interpret_mode")
 
 
+def has_pallas_sqrt_kernel(backend: str | None = None) -> bool:
+    """True when the fused sqrt-N grid kernel (``ops/pallas_sqrt.py``)
+    can compile AND run in this process: Pallas importable and the
+    backend is TPU.  Elsewhere resolvers degrade to ``kernel_impl=
+    "xla"`` with provenance (``api.resolved_eval_knobs`` reports
+    ``kernel_resolved_from="degraded"`` and counts it via
+    ``note_swallowed``) — the generic ``interpret=True`` engine is a
+    debugging device, not a serving path (``has_tpu_interpret_mode``).
+    Pass ``backend`` to probe without initializing one."""
+    try:
+        from jax.experimental import pallas  # noqa: F401
+    except Exception:  # pragma: no cover - pallas not shipped at all
+        return False
+    if backend is None:
+        try:
+            import jax
+            backend = jax.default_backend()
+        except Exception:  # pragma: no cover - no usable backend
+            return False
+    return backend == "tpu"
+
+
 def has_effects_barrier() -> bool:
     """True when ``jax.effects_barrier()`` exists (jax >= 0.4.x late
     line).  ``utils.profiling.Timer`` uses it to drain ALL in-flight

@@ -248,19 +248,29 @@ def test_stage_candidates_hardware_aware():
 
 
 def test_sqrtn_knob_space():
-    """scheme='sqrtn' enters the tuner with its own two-knob stage
+    """scheme='sqrtn' enters the tuner with its own three-knob stage
     order; candidates honor the live-slab budget and the heuristic is
     a member."""
     from dpf_tpu.core import sqrtn
-    assert search.SQRT_STAGES == ("row_chunk", "dot_impl")
+    assert search.SQRT_STAGES == ("row_chunk", "dot_impl", "kernel_impl")
     h = search.heuristic_knobs(4096, 64, prf_method=0, scheme="sqrtn")
-    assert set(h) == {"row_chunk", "dot_impl"}
+    assert set(h) == {"row_chunk", "dot_impl", "kernel_impl"}
     k, r = sqrtn.default_split(4096)
     assert h["row_chunk"] == sqrtn.choose_row_chunk(k=k, r=r, batch=64)
     cands = search.stage_candidates("row_chunk", h, n=4096, batch=64,
                                     prf_method=0, backend="cpu")
     assert h["row_chunk"] in cands
     assert cands == sqrtn.sqrt_chunk_candidates(r, k, 64)
+    # the fused grid kernel is only a candidate where it can run: TPU
+    # backend AND a PRF with a Pallas plane core (ids 1/2/4/5 — not the
+    # dummy or AES)
+    assert search.stage_candidates("kernel_impl", h, n=4096, batch=64,
+                                   prf_method=0, backend="cpu") == ["xla"]
+    assert search.stage_candidates("kernel_impl", h, n=4096, batch=64,
+                                   prf_method=0, backend="tpu") == ["xla"]
+    assert search.stage_candidates(
+        "kernel_impl", h, n=4096, batch=64, prf_method=2,
+        backend="tpu") == ["xla", "pallas"]
 
 
 def test_tune_eval_sqrtn_and_resolution(tmp_path, monkeypatch):
@@ -283,6 +293,7 @@ def test_tune_eval_sqrtn_and_resolution(tmp_path, monkeypatch):
         0, 2 ** 31, (n, 16), dtype=np.int32, endpoint=False)
     dpf.eval_init(table)
     knobs = dpf.resolved_eval_knobs(batch)
+    assert knobs.pop("kernel_resolved_from") == "tuned"
     assert knobs == rec["knobs"]
     ks = [dpf.gen(i, n)[0] for i in range(batch)]
     assert np.array_equal(np.asarray(dpf.eval_tpu(ks)),
