@@ -13,6 +13,7 @@
 #   --load             serve/bench_load.py          BENCH_LOAD_r10.json
 #   --chaos            serve/bench_chaos.py         BENCH_CHAOS_r11.json
 #   --trace            obs/bench_trace.py           BENCH_TRACE_r12.json
+#   --multihost        serve/bench_multihost.py     MULTIHOST_r14.json
 #
 # --serve: streaming serving benchmark (blocking loop vs pipelined
 # ServingEngine).  See docs/SERVING.md.
@@ -50,6 +51,15 @@
 # transitions and engine restarts, every served batch still gated;
 # --dryrun is the seconds-long CI smoke.  See docs/SERVING.md "Fault
 # tolerance & chaos testing".
+#
+# --multihost: multi-host serving cluster — the row-sharded table
+# behind a scatter/gather front-end (parallel/cluster.py), replaying
+# the seeded bursty trace through a baseline leg and two host-death
+# chaos legs (recovery by degrade-to-spare and by re-shard over the
+# survivors), one OS process per host by default (--simulate for the
+# in-process tier), availability + decision attribution via the flight
+# recorder, every merged answer gated against the scalar oracle;
+# --dryrun is the seconds-long CI smoke.  See docs/MULTIHOST.md.
 #
 # --trace: end-to-end observability — span tracing over the serving
 # path with a joint host+device digest for one tuned shape, the
@@ -125,6 +135,12 @@ if __name__ == "__main__":
         # forces the virtual CPU mesh first (utils/hermetic.py)
         from dpf_tpu.serve.bench_multichip import main
         main([a for a in sys.argv[1:] if a != "--multichip"])
+        sys.exit(0)
+    if "--multihost" in sys.argv:
+        # also before any backend touch: worker processes must inherit
+        # an environment whose jax state the parent has not finalized
+        from dpf_tpu.serve.bench_multihost import main
+        main([a for a in sys.argv[1:] if a != "--multihost"])
         sys.exit(0)
     if "--batch-pir" in sys.argv:
         from dpf_tpu.serve.bench_pir import main

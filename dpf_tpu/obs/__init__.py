@@ -24,9 +24,22 @@ overhead (committed record: BENCH_TRACE_r12.json).
 from .flight import FLIGHT, FlightRecorder, flight_dump  # noqa: F401
 from .metrics import (REGISTRY, Counter, Gauge, Histogram,  # noqa: F401
                       MetricsRegistry, default_registry,
-                      register_engine, register_router)
+                      register_cluster, register_engine, register_router)
 from .tracer import (NULL_SPAN, Span, Tracer, disable,  # noqa: F401
                      enable, get_tracer, joint_digest, span, tracing)
+
+
+def set_process_index(index: int | None) -> None:
+    """Label THIS process's observability output with its jax
+    ``process_index`` (multi-host serving): flight-recorder events gain
+    a ``process`` attribute and engine/router/cluster metric series a
+    ``process`` label, so merged cross-host dumps stay attributable.
+    ``multihost.initialize`` calls this on success; cluster workers set
+    their rank explicitly."""
+    from .flight import set_process_index as _flight
+    from .metrics import set_process_index as _metrics
+    _flight(index)
+    _metrics(index)
 
 
 def record_sections(flight_last: int = 64) -> dict:

@@ -23,9 +23,16 @@ Event kinds (full schema in docs/OBSERVABILITY.md):
 * ``fault``    — every injected-fault fire (kind, construction,
   bucket, arrival), written by ``FaultInjector``.
 * ``rebuild``  — supervisor engine rebuilds (ok/failed).
+* ``scatter`` / ``host_drop`` / ``cluster_recovery`` — the multi-host
+  tier (``parallel/cluster.py``): per-arrival scatter plans, detected
+  host losses, and the re-shard-or-degrade decision that answered each
+  loss (``decision`` ∈ {"reshard", "degrade"}).
 
 Events carry a monotonic timestamp relative to recorder start and a
 global sequence number, so interleavings across threads stay ordered.
+Multi-host runs stamp each event with the recording process's
+``process`` index (``set_process_index``), so merged rings stay
+attributable per host.
 Recording is always on: one dict + deque append per DECISION (not per
 query), bounded memory, no I/O — the ``--trace`` bench's overhead leg
 measures the full observability stack under 2% of qps.
@@ -51,12 +58,22 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self.recorded = 0           # total ever recorded (ring evicts)
+        self._process = None        # jax process_index label (multi-host)
+
+    def set_process(self, index: int | None) -> None:
+        """Stamp every subsequent event with a ``process`` label — the
+        ``jax.process_index()`` of this process (``multihost.initialize``
+        calls this on success; cluster workers set their rank), so a
+        multi-host flight merge stays attributable per host."""
+        self._process = None if index is None else int(index)
 
     def record(self, kind: str, **attrs) -> None:
         """Append one event; never raises (decision paths call this)."""
         try:
             ev = {"seq": 0, "t": round(time.monotonic() - self._t0, 6),
                   "kind": kind}
+            if self._process is not None and "process" not in attrs:
+                ev["process"] = self._process
             ev.update(attrs)
             with self._lock:
                 self.recorded += 1
@@ -95,3 +112,9 @@ def flight_dump(last: int | None = None) -> list:
     """Dump the process flight ring (the on-demand diagnosis entry
     point named by docs/OBSERVABILITY.md)."""
     return FLIGHT.dump(last=last)
+
+
+def set_process_index(index: int | None) -> None:
+    """Label the process ring's events with a process index
+    (multi-host serving: one flight ring per process, merged by rank)."""
+    FLIGHT.set_process(index)

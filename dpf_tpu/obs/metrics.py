@@ -356,6 +356,30 @@ class MetricsRegistry:
 #: the process registry everything self-registers into
 REGISTRY = MetricsRegistry()
 
+#: jax process_index label stamped on engine/router series (multi-host)
+_PROCESS_INDEX: int | None = None
+
+
+def set_process_index(index: int | None) -> None:
+    """Stamp engine/router series with a ``process`` label — the
+    ``jax.process_index()`` of this process.  ``multihost.initialize``
+    calls this on success; cluster workers set their rank.  A scrape
+    that merges per-host ``/metrics`` pages then stays attributable."""
+    global _PROCESS_INDEX
+    _PROCESS_INDEX = None if index is None else int(index)
+
+
+def _with_process(labels: dict, override=None) -> dict:
+    """Merge the process label into a sample's labels: an explicit
+    per-object ``process_index`` (the cluster's simulated hosts) wins
+    over the process-wide index; absent both, labels pass through."""
+    p = override if override is not None else _PROCESS_INDEX
+    if p is None or "process" in labels:
+        return labels
+    out = dict(labels)
+    out["process"] = int(p)
+    return out
+
 
 def default_registry() -> MetricsRegistry:
     return REGISTRY
@@ -410,7 +434,8 @@ def register_engine(engine, registry: MetricsRegistry | None = None):
     label = getattr(engine, "label", None) or "engine-%x" % id(engine)
 
     def emit(e):
-        return engine_samples(e.stats, {"engine": label})
+        return engine_samples(e.stats, _with_process(
+            {"engine": label}, getattr(e, "process_index", None)))
     reg.watch(engine, emit)
 
 
@@ -450,8 +475,46 @@ def register_router(router, registry: MetricsRegistry | None = None):
             out.append(("dpf_router_routed_from", "counter",
                         "routing-decision provenance",
                         {"source": src}, float(c)))
-        return out
+        return [(n, k, h, _with_process(l), v) for n, k, h, l, v in out]
     reg.watch(router, emit)
+
+
+def register_cluster(cluster, registry: MetricsRegistry | None = None):
+    """Export a ``parallel.cluster.ClusterRouter``'s host states, granule
+    assignments, recovery decisions and cluster-merged ``EngineCounters``
+    (``EngineCounters.merge`` pools the per-host rings) as first-class
+    series (weakly held)."""
+    reg = registry or REGISTRY
+    states = {"live": 0.0, "degraded": 1.0, "down": 2.0}
+
+    def emit(c):
+        out = []
+        for lb, node in c.hosts.items():
+            st = c.host_state(lb)
+            labels = _with_process({"host": lb},
+                                   getattr(node, "process_index", None))
+            out.append(("dpf_cluster_host_state", "gauge",
+                        "0=live 1=degraded 2=down", labels,
+                        states.get(st, -1.0)))
+            out.append(("dpf_cluster_host_granules", "gauge",
+                        "table granules assigned to the host", labels,
+                        float(len(c.assignment.get(lb, ())))))
+        live = sum(1 for lb in c.hosts if c.host_state(lb) == "live")
+        out.append(("dpf_cluster_hosts_live", "gauge",
+                    "hosts currently serving their own granules", {},
+                    float(live)))
+        out.append(("dpf_cluster_hosts_total", "gauge",
+                    "hosts the cluster was built with", {},
+                    float(len(c.hosts))))
+        for decision in ("reshard", "degrade"):
+            out.append(("dpf_cluster_recoveries", "counter",
+                        "host-loss recovery decisions",
+                        {"decision": decision},
+                        float(c.decision_counts.get(decision, 0))))
+        out.extend(engine_samples(c.counters(),
+                                  _with_process({"engine": "cluster"})))
+        return out
+    reg.watch(cluster, emit)
 
 
 def _process_samples():

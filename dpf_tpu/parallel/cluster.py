@@ -1,0 +1,745 @@
+"""Multi-host serving cluster: row-sharded table, scatter/gather
+front-end, and a host-loss recovery state machine.
+
+``parallel/sharded.py`` scales one *process* over its local mesh;
+``parallel/multihost.py`` initializes jax.distributed so many processes
+form one global mesh.  This module is the missing serving tier between
+them: a **cluster** of serving hosts, each owning a slice of the table,
+behind one front-end router — and a failure story when a host dies
+mid-trace.
+
+**Sharding model.**  The bit-reverse-permuted table splits into
+``hosts`` contiguous **granules** of ``granule = n // hosts`` rows.
+Each host wraps its granules in a ``ClusterShardServer`` whose
+``_dispatch_packed`` runs ``sharded.eval_leaf_range_local`` per granule
+— the *partial* DPF evaluation over just those rows — and sums the
+partials on device.  Because answers are additive int32 shares, partial
+dot products over disjoint row ranges sum (wrapping) to exactly the
+full-table answer; the front-end ``ClusterRouter`` scatters each batch
+to every covering host and merges the returned partials with a wrapping
+sum, bit-identical to a single-host eval (tests/test_cluster.py gates
+this against ``DPF.eval_cpu``).  ``row0`` is *traced*, so ONE compiled
+program per (granule, bucket) shape serves ANY granule — recovery moves
+granules between hosts without recompiling.
+
+**Failure story.**  Losses are detected three ways: a dispatch raising
+``HostDropped``/``EngineDead`` (serve/faults.py injects these under the
+``host_drop`` kind), a failed heartbeat (``check_hosts`` consults
+``FaultInjector.on_heartbeat``), or a per-host ``CircuitBreaker``
+opening after K consecutive transient failures.  All three converge on
+``_handle_drop``, which takes the host out of the scatter plan and
+answers the loss with one of two decisions (``policy=``):
+
+* ``"reshard"`` — the dead host's granules are redistributed
+  round-robin over the survivors (``add_granules`` = one ``device_put``
+  each; the traced-``row0`` program is already compiled), restoring
+  full replication-free coverage.
+* ``"degrade"``  — a front-end **spare** ``LocalHost`` takes over the
+  dead granules from the router's retained permuted table: partial
+  availability served locally while the dead host stays excluded.
+* ``"auto"``     — reshard when survivors exist, else degrade.
+
+Every decision lands in the flight recorder (``host_drop`` then
+``cluster_recovery`` with ``decision``), counts in ``decision_counts``,
+and moves the cluster-level ``EngineCounters`` (reshard ->
+``engine_restarts``, degrade -> ``failovers``) — the chaos bench
+(``benchmark.py --multihost``) asserts the attribution chain end to
+end.  ``obs.metrics.register_cluster`` exports host states, granule
+assignments and recovery decisions as first-class series.
+
+Hosts are pluggable: ``LocalHost`` (in-process, the simulation tier
+that runs everywhere) and ``cluster_net.RemoteHost`` (a socket client
+for ``cluster_worker`` processes) implement the same five-method
+protocol, so the router is transport-agnostic.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..core import expand, keygen
+from ..core.expand import DeadlineExceeded
+from ..obs.flight import FLIGHT
+from ..serve.engine import LoadShed, ServingEngine
+from ..serve.faults import CircuitBreaker, EngineDead, HostDropped
+from ..utils.profiling import EngineCounters, note_swallowed
+
+#: recovery decisions a policy can produce
+DECISIONS = ("reshard", "degrade")
+
+
+class HostUnreachable(RuntimeError):
+    """A serving host stopped answering (socket death, worker exit, or a
+    poisoned engine observed mid-submit).  The router treats it like
+    ``HostDropped``: exclusion + recovery, then a resubmit."""
+
+
+class ClusterUnavailable(RuntimeError):
+    """The live hosts (plus spare) no longer cover the whole table —
+    recovery failed or every host is down.  Answers would be WRONG
+    shares, so the router refuses to serve instead."""
+
+
+# ------------------------------------------------------------- planning
+
+def granule_rows(n: int, hosts: int) -> int:
+    """Rows per granule for an ``n``-row table over ``hosts`` hosts.
+
+    Both must be powers of two (the BFS leaf order and the chunked
+    expansion kernel require pow2 row counts), hosts <= n."""
+    if hosts < 1 or (hosts & (hosts - 1)):
+        raise ValueError("hosts must be a power of two >= 1 (got %d)"
+                         % hosts)
+    if n % hosts:
+        raise ValueError("hosts (%d) must divide n (%d)" % (hosts, n))
+    g = n // hosts
+    if g & (g - 1):
+        raise ValueError("granule %d is not a power of two (n=%d)"
+                         % (g, n))
+    return g
+
+
+def make_plan(n: int, hosts: int) -> dict:
+    """Initial granule assignment: host i owns rows [i*g, (i+1)*g) of
+    the PERMUTED table.  Returns {label: (row0, ...)} with labels
+    "host0".."host<H-1>" — the labels fault specs target."""
+    g = granule_rows(n, hosts)
+    return {"host%d" % i: (i * g,) for i in range(hosts)}
+
+
+def reshard_plan(lost, survivors) -> dict:
+    """Distribute ``lost`` granule row0s round-robin over ``survivors``
+    (ordered labels).  Returns {label: (row0, ...)} of ADDITIONS."""
+    if not survivors:
+        raise ValueError("no survivors to reshard onto")
+    out = {lb: [] for lb in survivors}
+    for i, row0 in enumerate(sorted(lost)):
+        out[survivors[i % len(survivors)]].append(row0)
+    return {lb: tuple(v) for lb, v in out.items() if v}
+
+
+# ---------------------------------------------------------- shard server
+
+class ClusterShardServer:
+    """One host's table slice behind the ``ServingEngine`` server
+    protocol (``_decode_batch`` / ``_dispatch_packed``).
+
+    Holds a list of (row0, device granule) shards over the bit-reverse
+    PERMUTED table; a dispatch evaluates each granule's partial share
+    via ``sharded.eval_leaf_range_local`` (traced row0 — one program
+    per (granule, bucket) shape regardless of which granules this host
+    holds) and sums the partials on device, still async.
+    ``add_granules`` is the recovery hook: a ``device_put`` per new
+    granule, no recompilation.
+    """
+
+    scheme = "logn"
+
+    def __init__(self, table_perm: np.ndarray, row0s, granule: int, *,
+                 prf_method: int, batch_size: int = 512,
+                 aes_impl: str | None = None):
+        import jax.numpy as jnp
+        if table_perm.ndim != 2:
+            raise ValueError("table_perm must be [n, entry_size]")
+        self._jnp = jnp
+        self._table_perm = table_perm          # shared ref, host memory
+        self.n = int(table_perm.shape[0])
+        self.entry_size = int(table_perm.shape[1])
+        self.granule = int(granule)
+        self.prf_method = int(prf_method)
+        self.batch_size = int(batch_size)
+        self.aes_impl = aes_impl
+        self._shards = []                      # [(row0, device [g, E])]
+        self.add_granules(row0s)
+
+    def add_granules(self, row0s) -> None:
+        """Upload granules [row0, row0+granule) (recovery/reshard
+        entry point — device transfer only, the jitted program for this
+        granule shape is shared with every other granule)."""
+        import jax
+        held = {r for r, _ in self._shards}
+        for row0 in row0s:
+            row0 = int(row0)
+            if row0 % self.granule or not 0 <= row0 < self.n:
+                raise ValueError("row0 %d not a granule boundary (g=%d)"
+                                 % (row0, self.granule))
+            if row0 in held:
+                continue
+            sl = self._table_perm[row0:row0 + self.granule]
+            self._shards.append((row0, jax.device_put(sl)))
+            held.add(row0)
+        self._shards.sort(key=lambda t: t[0])
+
+    def set_granules(self, row0s) -> None:
+        """Replace the held granules wholesale (hot-standby promotion:
+        the placeholder granule the standby warmed up on swaps for the
+        dead host's real granules — same traced shape, so still no
+        recompilation)."""
+        self._shards = []
+        self.add_granules(row0s)
+
+    @property
+    def granules(self) -> tuple:
+        return tuple(r for r, _ in self._shards)
+
+    def _decode_batch(self, keys) -> keygen.PackedKeys:
+        if isinstance(keys, keygen.PackedKeys):
+            pk = keys                          # front-end decoded once
+        else:
+            pk = keygen.decode_keys_batched(keys)
+        if pk.n != self.n:
+            raise ValueError("keys for n=%d but table has n=%d"
+                             % (pk.n, self.n))
+        return pk
+
+    def _dispatch_packed(self, pk: keygen.PackedKeys):
+        """Sum of this host's granule partials ([B, E] int32, device,
+        async).  Wrapping int32 adds keep additive-share semantics."""
+        if not self._shards:
+            raise RuntimeError("shard server holds no granules")
+        from . import sharded
+        chunk = expand.clamp_chunk(0, self.granule, pk.batch)
+        out = None
+        for row0, tbl in self._shards:
+            part = sharded.eval_leaf_range_local(
+                pk.cw1, pk.cw2, pk.last, tbl, row0, depth=pk.depth,
+                prf_method=self.prf_method, chunk_leaves=chunk,
+                n_total=self.n, aes_impl=self.aes_impl)
+            out = part if out is None else self._jnp.add(out, part)
+        return out
+
+
+# --------------------------------------------------------------- hosts
+
+class LocalHost:
+    """In-process serving host: a ``ClusterShardServer`` behind a
+    ``ServingEngine`` labeled with the host name (fault specs target
+    that label).  The simulation tier — and the node protocol
+    (``submit``/``heartbeat``/``add_granules``/``counters``/``stats``)
+    ``cluster_net.RemoteHost`` mirrors over sockets."""
+
+    def __init__(self, label: str, server: ClusterShardServer, *,
+                 process_index: int | None = None, buckets=None,
+                 injector=None, **engine_kw):
+        self.label = label
+        self.process_index = process_index
+        self.server = server
+        self._injector = injector
+        self.engine = ServingEngine(server, buckets=buckets, label=label,
+                                    injector=injector, **engine_kw)
+
+    def submit(self, pk):
+        return self.engine.submit(pk)
+
+    def heartbeat(self) -> dict:
+        """Liveness probe; raises ``HostDropped`` when this host is
+        (injected-)dead.  Returns a tiny status dict otherwise."""
+        if self._injector is not None:
+            self._injector.on_heartbeat(self.engine)
+        return {"host": self.label, "granules": self.server.granules,
+                "in_flight": self.engine.in_flight}
+
+    def add_granules(self, row0s) -> None:
+        self.server.add_granules(row0s)
+
+    @property
+    def granules(self) -> tuple:
+        return self.server.granules
+
+    def counters(self) -> EngineCounters:
+        return self.engine.stats
+
+    def stats(self) -> dict:
+        return {"granules": list(self.server.granules),
+                "counters": self.engine.stats.as_dict()}
+
+    def warmup(self) -> None:
+        self.engine.warmup()
+
+    def drain(self) -> None:
+        self.engine.drain()
+
+    def close(self) -> None:
+        pass
+
+
+# -------------------------------------------------------------- future
+
+class ClusterFuture:
+    """Merged result handle for one scattered batch.
+
+    ``result()`` gathers every host's partial share and merges them
+    with a wrapping int32 sum.  A host loss observed while gathering
+    (``HostDropped``/``EngineDead``/``HostUnreachable``) runs the
+    recovery state machine and RE-SERVES the whole batch on the
+    recovered cluster — bounded by the router's ``max_retries`` — so a
+    caller sees either a correct merged share or the terminal error.
+    """
+
+    def __init__(self, router, pk, parts):
+        self._router = router
+        self._pk = pk
+        self._parts = parts          # [(label, engine future)]
+        self._value = None
+
+    def done(self) -> bool:
+        return self._value is not None
+
+    def result(self):
+        if self._value is not None:
+            return self._value
+        r = self._router
+        parts, attempt = self._parts, 0
+        while True:
+            try:
+                self._value = r._merge(self._gather(parts))
+                return self._value
+            except (HostDropped, EngineDead, HostUnreachable):
+                attempt += 1
+                if attempt > r.max_retries:
+                    raise
+                parts = r._scatter(self._pk)   # recovered coverage
+
+    def _gather(self, parts):
+        out = []
+        for lb, fut in parts:
+            try:
+                out.append(fut.result())
+                self._router._note_ok(lb)
+            except (LoadShed, DeadlineExceeded):
+                raise                # decisions, not faults — propagate
+            except (HostDropped, EngineDead, HostUnreachable) as e:
+                self._router._handle_drop(lb, e)
+                raise
+            except Exception as e:
+                if self._router._note_failure(lb, e):
+                    raise HostUnreachable(
+                        "host %r breaker opened: %s" % (lb, e)) from e
+                raise
+        return out
+
+
+# -------------------------------------------------------------- router
+
+class ClusterRouter:
+    """Scatter/gather front-end over a set of serving hosts.
+
+    Args:
+      nodes: host-protocol objects (``LocalHost``/``RemoteHost``),
+        labels unique.
+      granule: rows per granule (``granule_rows(n, hosts)``).
+      table_perm: the full PERMUTED table (host memory).  Required for
+        the ``degrade`` path (the front-end spare serves the dead
+        granules from it); ``None`` restricts recovery to ``reshard``.
+      policy: ``"reshard"`` | ``"degrade"`` | ``"auto"`` (reshard when
+        survivors exist, else degrade).
+      injector: ``faults.FaultInjector`` — heartbeats consult
+        ``on_heartbeat`` through each node; the engines already consult
+        the dispatch/result points.
+      breaker_failures/breaker_reset_s: per-host circuit breakers; a
+        breaker *opening* is treated as a host loss (the open callback
+        runs ``_handle_drop``), which is exactly "the breaker keeps the
+        dead host out of the scatter plan".
+      max_retries: whole-batch re-serves a ``ClusterFuture`` may attempt
+        after recoveries.
+      standby: pre-build and warm the front-end spare at construction
+        time (on a placeholder granule — row0 is traced, so the same
+        compiled programs serve whichever granules later die).  A
+        ``degrade`` failover then costs one ``device_put`` swap instead
+        of a jit compile inside the recovery window.  Matters most when
+        the front-end process never served (multiprocess clusters,
+        where the workers hold the compile caches).
+
+    ``hosts``/``assignment``/``host_state``/``decision_counts``/
+    ``counters`` form the observability surface
+    ``obs.metrics.register_cluster`` exports.
+    """
+
+    def __init__(self, nodes, *, granule: int, table_perm=None,
+                 policy: str = "auto", injector=None,
+                 breaker_failures: int = 3, breaker_reset_s: float = 30.0,
+                 max_retries: int = 2, spare_engine_kw=None,
+                 prf_method: int | None = None, standby: bool = False):
+        if policy not in DECISIONS + ("auto",):
+            raise ValueError("policy must be reshard|degrade|auto "
+                             "(got %r)" % (policy,))
+        self.hosts = {node.label: node for node in nodes}
+        if len(self.hosts) != len(list(nodes)):
+            raise ValueError("duplicate host labels")
+        self.granule = int(granule)
+        self._table_perm = table_perm
+        self.policy = policy
+        self.injector = injector
+        self.max_retries = int(max_retries)
+        self._spare_engine_kw = dict(spare_engine_kw or {})
+        first = next(iter(self.hosts.values()))
+        self.n = first.server.n if hasattr(first, "server") else first.n
+        if prf_method is None:  # remote nodes carry no server object
+            srv = getattr(first, "server", None)
+            prf_method = getattr(srv, "prf_method", None)
+        self._prf_method = prf_method
+        self._all_granules = frozenset(range(0, self.n, self.granule))
+        self._assign = {lb: tuple(node.granules)
+                        for lb, node in self.hosts.items()}
+        self._down = set()
+        self._lock = threading.RLock()
+        self.spare = None
+        self.recovery = EngineCounters()
+        self.decision_counts = {d: 0 for d in DECISIONS}
+        self.breakers = {
+            lb: CircuitBreaker(failures=breaker_failures,
+                               reset_s=breaker_reset_s, name=lb,
+                               on_open=self._on_breaker_open)
+            for lb in self.hosts}
+        covered = set()
+        for g in self._assign.values():
+            covered.update(g)
+        if covered != set(self._all_granules):
+            raise ValueError("initial assignment does not tile the "
+                             "table: missing %s"
+                             % sorted(self._all_granules - covered))
+        if standby:
+            # hot standby: compile the spare's programs NOW, while the
+            # cluster is healthy, on a placeholder granule; _degrade
+            # promotes it with a set_granules swap (no recompiles)
+            self.spare = self._build_spare((0,))
+        try:
+            from ..obs.metrics import register_cluster
+            register_cluster(self)
+        except Exception as e:  # observability must never break serving
+            note_swallowed("cluster.register_metrics", e, self.recovery)
+
+    # ------------------------------------------------------ construction
+
+    @classmethod
+    def local(cls, table, hosts: int = 2, *, prf_method=None,
+              oracle=None, buckets=None, injector=None,
+              engine_kw=None, **router_kw) -> "ClusterRouter":
+        """Build an all-in-process cluster over ``table`` — the
+        simulation tier (tests, the ``--multihost`` bench's fallback
+        mode) exercising the identical scatter/recovery state machine
+        the multiprocess tier runs.
+
+        ``oracle`` (an ``api.DPF``) supplies ``prf_method`` when not
+        given explicitly; consults the tuning cache for cluster scatter
+        knobs (bucket ladder / in-flight window) unless ``buckets``
+        pins them.
+        """
+        if prf_method is None:
+            if oracle is not None:
+                prf_method = oracle.prf_method
+            else:
+                from ..api import DPF
+                prf_method = DPF.DEFAULT_PRF
+        tbl = np.ascontiguousarray(np.asarray(table, dtype=np.int32))
+        n = tbl.shape[0]
+        g = granule_rows(n, hosts)
+        perm = expand.permute_table(tbl)
+        kw = dict(engine_kw or {})
+        if buckets is None:
+            try:
+                from ..tune.serve_tune import lookup_cluster_knobs
+                knobs = lookup_cluster_knobs(
+                    n=n, entry_size=tbl.shape[1], hosts=hosts,
+                    prf_method=prf_method,
+                    cap=kw.get("cap", 512))
+                if knobs:
+                    buckets = knobs["buckets"]
+                    kw.setdefault("max_in_flight", knobs["max_in_flight"])
+            except Exception as e:  # tuning must never break serving
+                note_swallowed("cluster.tune_lookup", e)
+        kw.pop("cap", None)
+        nodes = []
+        plan = sorted(make_plan(n, hosts).items(),
+                      key=lambda kv: int(kv[0][4:]))
+        for i, (lb, row0s) in enumerate(plan):
+            srv = ClusterShardServer(perm, row0s, g,
+                                     prf_method=prf_method)
+            nodes.append(LocalHost(lb, srv, process_index=i,
+                                   buckets=buckets, injector=injector,
+                                   **kw))
+        router_kw.setdefault("spare_engine_kw",
+                             dict(kw, buckets=buckets))
+        return cls(nodes, granule=g, table_perm=perm, injector=injector,
+                   **router_kw)
+
+    # ---------------------------------------------------------- serving
+
+    def submit(self, keys) -> ClusterFuture:
+        """Scatter one batch to every covering host; returns a merged
+        future.  Keys decode ONCE at the front-end (hosts receive the
+        packed batch).  A host loss observed during the scatter runs
+        recovery and raises ``HostUnreachable`` — ``submit_resilient``
+        retries on the recovered plan."""
+        pk = (keys if isinstance(keys, keygen.PackedKeys)
+              else keygen.decode_keys_batched(keys))
+        return ClusterFuture(self, pk, self._scatter(pk))
+
+    def _scatter(self, pk) -> list:
+        plan = self._scatter_plan()
+        FLIGHT.record(
+            "scatter", hosts=sorted(lb for lb, _ in plan),
+            batch=pk.batch,
+            arrival=getattr(self.injector, "arrival", None),
+            granules={lb: len(node.granules) for lb, node in plan})
+        parts = []
+        for lb, node in plan:
+            try:
+                parts.append((lb, node.submit(pk)))
+            except (LoadShed, DeadlineExceeded):
+                raise                # decisions, not faults
+            except (HostDropped, EngineDead, HostUnreachable) as e:
+                self._handle_drop(lb, e)
+                raise HostUnreachable(
+                    "host %r lost mid-scatter (recovered; resubmit): %s"
+                    % (lb, e)) from e
+            except Exception as e:
+                if self._note_failure(lb, e):
+                    raise HostUnreachable(
+                        "host %r breaker opened mid-scatter: %s"
+                        % (lb, e)) from e
+                raise
+        return parts
+
+    def submit_resilient(self, keys) -> ClusterFuture:
+        """``submit`` + bounded retries across host-loss recoveries."""
+        attempt = 0
+        while True:
+            try:
+                return self.submit(keys)
+            except (HostDropped, EngineDead, HostUnreachable):
+                attempt += 1
+                if attempt > self.max_retries:
+                    raise
+                self.recovery.inc("retries")
+
+    def _scatter_plan(self) -> list:
+        """(label, node) pairs covering the whole table: live hosts
+        (breaker-open hosts are already down — the open callback ran
+        recovery) plus the spare once ASSIGNED granules (an unpromoted
+        hot standby holds only its warmup placeholder and stays out)."""
+        with self._lock:
+            plan = [(lb, node) for lb, node in self.hosts.items()
+                    if lb not in self._down and node.granules]
+            if self.spare is not None and self._assign.get("spare"):
+                plan.append(("spare", self.spare))
+            covered = set()
+            for _, node in plan:
+                covered.update(node.granules)
+        missing = self._all_granules - covered
+        if missing:
+            raise ClusterUnavailable(
+                "no live host covers granule rows %s"
+                % sorted(missing)[:4])
+        return plan
+
+    def _merge(self, parts):
+        """Wrapping int32 sum of per-host partial shares == the
+        full-table additive share (disjoint row ranges commute with
+        the share sum)."""
+        out = np.array(parts[0], dtype=np.int32, copy=True)
+        with np.errstate(over="ignore"):
+            for p in parts[1:]:
+                out += np.asarray(p, dtype=np.int32)
+        return out
+
+    # --------------------------------------------------------- liveness
+
+    def check_hosts(self) -> dict:
+        """Heartbeat sweep: probe every not-down host, running the
+        recovery state machine for any that fail — host loss is
+        detectable BETWEEN dispatches, not only when traffic hits the
+        dead host.  Returns {label: state}."""
+        for lb, node in list(self.hosts.items()):
+            if lb in self._down:
+                continue
+            try:
+                node.heartbeat()
+            except (HostDropped, EngineDead, HostUnreachable) as e:
+                self._handle_drop(lb, e)
+            except Exception as e:
+                self._note_failure(lb, e)
+        return {lb: self.host_state(lb) for lb in self.hosts}
+
+    def _note_ok(self, lb: str) -> None:
+        br = self.breakers.get(lb)
+        if br is not None and lb not in self._down:
+            br.record_success()
+
+    def _note_failure(self, lb: str, e) -> bool:
+        """Count a transient failure on ``lb``'s breaker; True when the
+        breaker is now open (the open callback already ran recovery)."""
+        br = self.breakers.get(lb)
+        if br is None:
+            return False
+        return br.record_failure() == "open"
+
+    def _on_breaker_open(self, breaker) -> None:
+        lb = breaker.name
+        if lb in self.hosts and lb not in self._down:
+            self._handle_drop(lb, HostUnreachable(
+                "host %r breaker opened after %d consecutive failures"
+                % (lb, breaker.consecutive)))
+
+    # --------------------------------------------------------- recovery
+
+    def _handle_drop(self, lb: str, err) -> None:
+        """The recovery state machine: exclude the host, then answer
+        the loss per ``policy`` (reshard over survivors, or degrade to
+        the front-end spare).  Idempotent per host; serialized under
+        the router lock so concurrent observers of one loss run ONE
+        recovery."""
+        with self._lock:
+            if lb in self._down or lb not in self.hosts:
+                return
+            self._down.add(lb)
+            arrival = getattr(self.injector, "arrival", None)
+            FLIGHT.record("host_drop", host=lb, arrival=arrival,
+                          error=type(err).__name__, detail=str(err))
+            br = self.breakers.get(lb)
+            while br is not None and br.state != "open":
+                br.record_failure()   # loss confirmed: pin the breaker
+            lost = self._assign.get(lb, ())
+            self._assign[lb] = ()
+            survivors = [l for l in self.hosts
+                         if l not in self._down]
+            decision = self.policy
+            if decision == "auto":
+                decision = "reshard" if survivors else "degrade"
+            try:
+                if decision == "reshard":
+                    self._reshard(lost, survivors)
+                else:
+                    self._degrade(lost)
+            except Exception as e:
+                FLIGHT.record("cluster_recovery", host=lb,
+                              decision=decision, ok=False,
+                              error=type(e).__name__)
+                raise ClusterUnavailable(
+                    "recovery (%s) for host %r failed: %s"
+                    % (decision, lb, e)) from e
+            self.decision_counts[decision] += 1
+            FLIGHT.record("cluster_recovery", host=lb, decision=decision,
+                          granules=sorted(lost), arrival=arrival,
+                          survivors=survivors, ok=True)
+
+    def _reshard(self, lost, survivors) -> None:
+        adds = reshard_plan(lost, survivors)
+        for s_lb, row0s in adds.items():
+            self.hosts[s_lb].add_granules(row0s)
+            self._assign[s_lb] = tuple(
+                sorted(set(self._assign[s_lb]) | set(row0s)))
+        # a reshard re-homes table state, the cluster analogue of a
+        # supervisor engine rebuild
+        self.recovery.inc("engine_restarts")
+
+    def _build_spare(self, row0s) -> LocalHost:
+        if self._table_perm is None:
+            raise ClusterUnavailable(
+                "degrade needs the front-end table (table_perm=None)")
+        if self._prf_method is None:
+            raise ClusterUnavailable(
+                "degrade needs prf_method (pass it to the router "
+                "when hosts are remote)")
+        srv = ClusterShardServer(self._table_perm, row0s, self.granule,
+                                 prf_method=self._prf_method)
+        kw = dict(self._spare_engine_kw)
+        buckets = kw.pop("buckets", None)
+        spare = LocalHost("spare", srv, buckets=buckets,
+                          injector=self.injector, **kw)
+        spare.warmup()
+        return spare
+
+    def _degrade(self, lost) -> None:
+        if self.spare is None:
+            self.spare = self._build_spare(lost)
+        elif not self._assign.get("spare"):
+            # promote the hot standby: swap its placeholder granule
+            # for the dead host's real ones — device_put only, the
+            # warmed programs already fit (row0 is traced)
+            self.spare.server.set_granules(lost)
+        else:
+            self.spare.add_granules(lost)
+        self._assign["spare"] = tuple(
+            sorted(set(self._assign.get("spare", ())) | set(lost)))
+        # dead granules fail over to the spare, batches keep flowing
+        self.recovery.inc("failovers")
+
+    # ---------------------------------------------------- observability
+
+    def host_state(self, lb: str) -> str:
+        """"live" | "degraded" (breaker not closed but not confirmed
+        down) | "down"."""
+        if lb == "spare":
+            return "live" if (self.spare is not None
+                              and self._assign.get("spare")) else "down"
+        if lb in self._down:
+            return "down"
+        br = self.breakers.get(lb)
+        if br is not None and br.state != "closed":
+            return "degraded"
+        return "live"
+
+    @property
+    def assignment(self) -> dict:
+        with self._lock:
+            return {lb: tuple(g) for lb, g in self._assign.items()}
+
+    def counters(self) -> EngineCounters:
+        """Cluster-merged serving counters: every host's engine ring +
+        the spare's + the router-level recovery events
+        (``EngineCounters.merge``)."""
+        agg = EngineCounters()
+        for lb, node in self.hosts.items():
+            try:
+                agg.merge(node.counters())
+            except Exception as e:  # a dead host keeps no books; the
+                # router-side recovery counters already recorded it
+                note_swallowed("cluster.peer_unreachable", e,
+                               self.recovery)
+        if self.spare is not None:
+            agg.merge(self.spare.counters())
+        agg.merge(self.recovery)
+        return agg
+
+    def stats(self) -> dict:
+        return {
+            "hosts": {lb: self.host_state(lb) for lb in self.hosts},
+            "assignment": {lb: list(g)
+                           for lb, g in self.assignment.items()},
+            "down": sorted(self._down),
+            "decision_counts": dict(self.decision_counts),
+            "counters": self.counters().as_dict(),
+            "breakers": {lb: br.as_dict()
+                         for lb, br in self.breakers.items()},
+            "spare_granules": (list(self.spare.granules)
+                               if self.spare is not None else []),
+        }
+
+    # ------------------------------------------------------- lifecycle
+
+    def warmup(self) -> None:
+        for lb, node in self.hosts.items():
+            if lb not in self._down:
+                node.warmup()
+
+    def drain(self) -> None:
+        for lb, node in self.hosts.items():
+            if lb in self._down:
+                continue
+            try:
+                node.drain()
+            except Exception as e:  # a dying host must not block the
+                # drain of the healthy ones
+                note_swallowed("cluster.drain", e, self.recovery)
+        if self.spare is not None:
+            self.spare.drain()
+
+    def close(self) -> None:
+        for node in self.hosts.values():
+            try:
+                node.close()
+            except Exception as e:
+                note_swallowed("cluster.close", e, self.recovery)

@@ -37,7 +37,8 @@ def is_initialized() -> bool:
 
 def initialize(coordinator_address: str | None = None,
                num_processes: int | None = None,
-               process_id: int | None = None):
+               process_id: int | None = None,
+               initialization_timeout_s: float | None = None):
     """Initialize jax.distributed for multi-process runs (idempotent).
 
     With explicit arguments, failures propagate.  With no arguments,
@@ -46,18 +47,30 @@ def initialize(coordinator_address: str | None = None,
     (plain single-process run, tests) degrades to a no-op returning False
     with the cause recorded (``process_info().init_error`` /
     ``init_error()``) so a half-formed cluster is visible.
+
+    ``initialization_timeout_s`` bounds the coordinator handshake: with
+    explicit coordinator args and a coordinator that never comes up,
+    jax's default is a 300 s hang — a worker in a crash-looping pod
+    should fail fast instead.  The timeout cause (like every failure
+    cause now) is surfaced through the ``init_error`` channel even on
+    the raising paths, so post-mortems see WHY, not just a stack.
     """
     global _initialized, _init_error
     import jax
     if is_initialized():
         _init_error = None
         return True
+    kw = {}
+    if initialization_timeout_s is not None:
+        t = max(1, int(initialization_timeout_s))
+        kw = _timeout_kwargs(jax, t)
     try:
         jax.distributed.initialize(coordinator_address=coordinator_address,
                                    num_processes=num_processes,
-                                   process_id=process_id)
+                                   process_id=process_id, **kw)
         _initialized = True
         _init_error = None
+        _label_observability(jax)
         return True
     except Exception as e:
         # belt-and-braces for external initialization on JAX versions
@@ -65,24 +78,85 @@ def initialize(coordinator_address: str | None = None,
         if "already initialized" in str(e).lower():
             _initialized = True
             _init_error = None
+            _label_observability(jax)
             return True
+        cause = "%s: %s" % (type(e).__name__, e)
+        if initialization_timeout_s is not None and _looks_like_timeout(e):
+            cause = ("InitializationTimeout: coordinator %s did not "
+                     "respond within %.0fs (%s)"
+                     % (coordinator_address or "<auto>",
+                        initialization_timeout_s, cause))
+        # keep the cause on EVERY path — a raising worker's init_error()
+        # is what the launcher/post-mortem reads
+        _init_error = cause
         if (coordinator_address is not None or num_processes is not None
                 or process_id is not None or _cluster_expected()):
             raise  # a real cluster failed to initialize: surface it
         # no cluster detected: single-process run — but keep the cause:
         # on a real pod a mis-set env var lands here and the only
         # symptom is process_count()==1
-        _init_error = "%s: %s" % (type(e).__name__, e)
         return False
+
+
+def _timeout_kwargs(jax_mod, timeout_s: int) -> dict:
+    """``initialization_timeout`` pass-through when this jax supports it
+    (>= 0.4.15); absent, the timeout degrades to jax's default with the
+    degradation recorded (never a silent drop of the caller's bound)."""
+    import inspect
+    global _init_error
+    try:
+        params = inspect.signature(
+            jax_mod.distributed.initialize).parameters
+    except (TypeError, ValueError):  # pragma: no cover - exotic builds
+        params = {}
+    if "initialization_timeout" in params:
+        return {"initialization_timeout": timeout_s}
+    from ..utils.profiling import note_swallowed
+    note_swallowed("multihost.timeout_unsupported", RuntimeError(
+        "jax.distributed.initialize has no initialization_timeout "
+        "parameter on jax %s" % getattr(jax_mod, "__version__", "?")))
+    return {}
+
+
+def _looks_like_timeout(e: BaseException) -> bool:
+    msg = str(e).lower()
+    return ("timeout" in msg or "timed out" in msg
+            or "deadline" in msg or isinstance(e, TimeoutError))
+
+
+def _label_observability(jax_mod) -> None:
+    """Stamp this process's flight/metrics output with its rank."""
+    try:
+        from ..obs import set_process_index
+        set_process_index(jax_mod.process_index())
+    except Exception:  # observability must never break init
+        pass
 
 
 def _cluster_expected() -> bool:
     """Heuristic: does the environment look multi-process?  Used to decide
-    whether an auto-detect initialization failure is a real error."""
+    whether an auto-detect initialization failure is a real error.
+
+    ``DPF_EXPECT_CLUSTER`` is the explicit override in both directions
+    ("1"/"true" forces loud failure, "0"/"false" forces the silent
+    single-process fallback); otherwise coordinator-address vars, a
+    multi-worker TPU hostname list, and the ``JAX_NUM_PROCESSES``-style
+    launcher hints all mean a mis-launched pod should fail loudly
+    instead of silently serving from one process."""
     import os
+    explicit = os.environ.get("DPF_EXPECT_CLUSTER", "").strip().lower()
+    if explicit:
+        return explicit not in ("0", "false", "no", "off")
     if os.environ.get("JAX_COORDINATOR_ADDRESS") or \
             os.environ.get("COORDINATOR_ADDRESS"):
         return True
+    for var in ("JAX_NUM_PROCESSES", "SLURM_NTASKS",
+                "OMPI_COMM_WORLD_SIZE"):
+        try:
+            if int(os.environ.get(var, "") or 0) > 1:
+                return True
+        except ValueError:
+            pass  # an unparsable hint is not a cluster claim
     hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     return "," in hosts  # more than one worker host
 

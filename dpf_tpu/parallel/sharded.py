@@ -53,9 +53,12 @@ if _shard_map is None:  # pragma: no cover - version dependent
 def _pvary(x, axes):
     """Type a shard_map scan carry as varying over the mesh axes.  On
     jaxlibs without varying-types (no ``lax.pvary``) the carry mismatch
-    this guards against does not exist — identity is correct."""
+    this guards against does not exist — identity is correct.  Empty
+    ``axes`` (a caller outside any shard_map, e.g. the cluster tier's
+    host-local leaf-range eval) is always identity: ``lax.pvary`` over
+    axis names that don't exist would raise."""
     fn = getattr(jax.lax, "pvary", None)
-    return fn(x, axes) if fn is not None else x
+    return fn(x, axes) if fn is not None and axes else x
 
 
 def make_mesh(n_table: int | None = None, n_batch: int = 1,
@@ -157,7 +160,8 @@ def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
 def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
                      prf_method: int, chunk_leaves: int, n_total: int,
                      aes_impl: str | None = None, psum_group: int = 0,
-                     axis_name: str | None = None):
+                     axis_name: str | None = None,
+                     carry_axes=("batch", "table")):
     """Expand only BFS leaves [row0, row0 + tbl.rows) and contract locally.
 
     Phase 1 walks root -> this shard's frontier; because the shard is a
@@ -170,6 +174,10 @@ def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
     ``out`` is already the mesh-wide sum (``psummed=True``); otherwise
     ``out`` is this shard's local partial and the caller applies the
     terminal psum.
+
+    ``carry_axes`` types the scan carry for shard_map callers; pass
+    ``()`` when calling OUTSIDE a mesh program (the multi-host cluster
+    tier evaluates granules host-locally through exactly this path).
     """
     rows = tbl.shape[0]
     e = tbl.shape[1]
@@ -210,12 +218,42 @@ def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
     if not g:
         # inside shard_map the scan carry must be typed as varying over
         # the mesh axes (the body's output is), or the carry mismatches
-        acc, _ = jax.lax.scan(body, _pvary(zeros, ("batch", "table")),
+        acc, _ = jax.lax.scan(body, _pvary(zeros, carry_axes),
                               (frontier, tbl_chunks))
         return acc, False
     return _scan_psum_groups(body, zeros, (
         frontier.reshape(f_local // g, g, bsz, 4),
         tbl_chunks.reshape(f_local // g, g, c, e)), axis_name), True
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("depth", "prf_method", "chunk_leaves",
+                                    "n_total", "aes_impl"))
+def eval_leaf_range_local(cw1, cw2, last, tbl, row0, *, depth: int,
+                          prf_method: int, chunk_leaves: int, n_total: int,
+                          aes_impl: str | None = None):
+    """Host-local partial evaluation of one contiguous BFS leaf range —
+    the single-device (no-mesh) entry to ``_eval_leaf_range``.
+
+    This is the multi-host cluster tier's per-host primitive
+    (``parallel/cluster.py``): a host owning table rows
+    [row0, row0 + tbl.rows) evaluates the FULL-domain key batch against
+    only its rows and returns the [B, E] int32 partial share; partials
+    from hosts covering disjoint ranges sum (int32 wrap) to the exact
+    single-device answer, because additive secret shares commute with
+    partial dot products.
+
+    ``row0`` is a TRACED scalar (unlike the mesh path's
+    ``shard_ix * shard_rows`` it arrives from the host), so one compiled
+    program per (rows, batch) shape serves ANY granule — a re-shard
+    after a host drop moves granules between hosts without recompiling.
+    """
+    out, _ = _eval_leaf_range(
+        cw1, cw2, last, tbl, jnp.asarray(row0, dtype=jnp.int32),
+        depth=depth, prf_method=prf_method, chunk_leaves=chunk_leaves,
+        n_total=n_total, aes_impl=aes_impl, psum_group=0, axis_name=None,
+        carry_axes=())
+    return out
 
 
 def shard_table_mixed(table_i32: np.ndarray, mesh: Mesh):

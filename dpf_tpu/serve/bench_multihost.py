@@ -1,0 +1,480 @@
+"""Multi-host chaos benchmark: availability across a host death.
+
+``benchmark.py --multihost``.  Builds a serving CLUSTER over one table
+(``parallel/cluster.py``: row-sharded granules, scatter/gather
+front-end, re-shard-or-degrade recovery) and replays the same seeded
+bursty trace three times:
+
+* **baseline**       — full cluster, no failures: the availability
+  reference for this machine/trace.
+* **chaos_degrade**  — one host dies mid-trace; recovery policy
+  ``degrade``: a front-end spare takes over the dead granules while
+  the breaker keeps the dead host out of the scatter plan.
+* **chaos_reshard**  — the same death; policy ``reshard``: the dead
+  host's granules are redistributed over the survivors (device_put
+  only — the traced-row0 program never recompiles).
+
+Two execution modes run the IDENTICAL router/recovery state machine:
+
+* ``multiprocess`` (default) — one OS process per host
+  (``parallel/cluster_worker.py`` over the framed-pickle socket
+  transport); the chaos legs SIGKILL the victim worker at a fixed
+  arrival index, so the loss is a *real* process death detected
+  through the transport (``HostUnreachable``), not a simulated flag.
+  This forced-multiprocess CPU rehearsal runs on any jax — the workers
+  are independent single-process jax runtimes; cross-process
+  *collectives* (``utils.compat.has_cpu_multiprocess``, jax >= 0.5)
+  are not required and the record says which story it proves.
+* ``simulated`` — all hosts in-process; the death is an injected
+  ``host_drop`` fault (``serve/faults.py``, deterministic under the
+  plan seed).  The CI smoke fallback and the tier-1 test path.
+
+**Availability** is the correct-within-SLO fraction: every merged
+answer is bit-gated against the scalar oracle (``DPF.eval_cpu``)
+before the client accepts it, failed gates re-serve through
+``ClusterRouter.submit_resilient``, and the record proves the drop was
+*attributed*: the flight recorder must contain the ``host_drop`` event
+and the ``cluster_recovery`` decision that answered it, per leg.
+Committed record: ``MULTIHOST_r14.json``; the identical command
+produces the relay-pod record.
+
+  env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+      python benchmark.py --multihost [--dryrun] [--simulate] \
+      [--hosts H] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+from ..core import expand
+from ..core.expand import DeadlineExceeded
+from ..obs import FLIGHT, flight_dump, record_sections
+from ..utils.profiling import note_swallowed, swallowed_snapshot
+from .bench_load import _batch_for, _key_pool, _slo_stats, replay
+from .engine import LoadShed
+from .faults import FaultPlan, FaultSpec
+from . import loadgen
+
+
+class _FailedBatch:
+    """Future-shaped sentinel for an arrival whose serve attempts were
+    exhausted (counts unavailable in the availability fraction)."""
+    ok = False
+
+    def done(self) -> bool:
+        return True
+
+    def result(self):
+        return None
+
+
+class _VerifiedFuture:
+    """The full client protocol for one scattered batch: resolve the
+    merged share, bit-gate it against the scalar-oracle references,
+    and on a failed gate or a resolve-time fault RE-SERVE through
+    ``submit_resilient`` (the re-serve cost lands in the measured
+    latency, so recovery is paid for inside the availability number)."""
+
+    __slots__ = ("client", "a", "j", "fut", "ok", "_value")
+
+    def __init__(self, client, a, j, fut):
+        self.client = client
+        self.a = a
+        self.j = j
+        self.fut = fut
+        self.ok = None
+        self._value = None
+
+    def done(self) -> bool:
+        return self.ok is not None or self.fut.done()
+
+    def result(self):
+        if self.ok is not None:
+            return self._value
+        c = self.client
+        out = None
+        for attempt in range(c.max_reserves + 1):
+            try:
+                out = np.asarray(self.fut.result())
+            except (LoadShed, DeadlineExceeded):
+                raise
+            except Exception:
+                out = None
+            if out is not None:
+                if np.array_equal(out, c.refs_for(self.j, self.a.batch)):
+                    self.ok = True
+                    self._value = out
+                    return out
+                c.detected_corruptions += 1
+            if attempt >= c.max_reserves:
+                break
+            c.reserves += 1
+            try:
+                self.fut = c.cluster.submit_resilient(
+                    c.keys_for(self.j, self.a.batch))
+            except Exception:
+                break
+        self.ok = False
+        self._value = out
+        c.failed_batches += 1
+        return out
+
+
+class _ClusterClient:
+    """The submit side of one leg: heartbeat sweep every
+    ``hb_every`` arrivals (host loss is detectable BETWEEN dispatches),
+    the multiprocess kill switch at the scripted arrival, then
+    ``submit_resilient`` wrapped in the verify-and-reserve protocol."""
+
+    def __init__(self, cluster, pool, injector, *, max_reserves=3,
+                 hb_every=8, kill_at=None, victim_node=None):
+        self.cluster = cluster
+        self.pool = pool
+        self.injector = injector
+        self.max_reserves = max_reserves
+        self.hb_every = hb_every
+        self.kill_at = kill_at
+        self.victim_node = victim_node      # RemoteHost to SIGKILL
+        self.killed = False
+        self.detected_corruptions = 0
+        self.failed_batches = 0
+        self.reserves = 0
+
+    def keys_for(self, j, b):
+        return _batch_for(self.pool, j, b)[0]
+
+    def refs_for(self, j, b):
+        _, idxs = _batch_for(self.pool, j, b)
+        return self.pool[1][idxs]
+
+    def submit(self, a, j):
+        if self.injector is not None:
+            self.injector.begin_arrival(j)
+        if (self.victim_node is not None and not self.killed
+                and self.kill_at is not None and j >= self.kill_at):
+            self.victim_node.kill()         # a REAL process death
+            self.killed = True
+        if self.hb_every and j and j % self.hb_every == 0:
+            self.cluster.check_hosts()
+        try:
+            fut = self.cluster.submit_resilient(
+                self.keys_for(j, a.batch))
+        except (LoadShed, DeadlineExceeded):
+            raise
+        except Exception:
+            self.failed_batches += 1
+            return _FailedBatch()
+        return _VerifiedFuture(self, a, j, fut)
+
+
+def _build_cluster(mode, table, hosts, *, oracle, buckets, policy,
+                   injector, breaker_reset_s, table_seed):
+    """A fresh cluster for one leg.  Returns (cluster, victim_node) —
+    victim_node is the RemoteHost the chaos legs kill (None in
+    simulated mode, where the injector supplies the death)."""
+    from ..parallel.cluster import ClusterRouter
+
+    if mode == "multiprocess":
+        from ..parallel import cluster_net
+        n, e = table.shape
+        nodes = cluster_net.spawn_cluster(
+            n, e, hosts, table_seed=table_seed,
+            prf_method=oracle.prf_method, buckets=buckets)
+        cluster = ClusterRouter(
+            nodes, granule=n // hosts,
+            table_perm=expand.permute_table(table), policy=policy,
+            prf_method=oracle.prf_method,
+            breaker_reset_s=breaker_reset_s,
+            spare_engine_kw={"buckets": buckets},
+            # the front-end never served, so its jit caches are cold:
+            # warm the degrade spare BEFORE the chaos window, making
+            # failover a device_put swap instead of a compile stall
+            standby=True)
+        return cluster, dict(zip([nd.label for nd in nodes], nodes))
+    cluster = ClusterRouter.local(
+        table, hosts=hosts, oracle=oracle, buckets=buckets,
+        injector=injector, policy=policy,
+        breaker_reset_s=breaker_reset_s)
+    return cluster, None
+
+
+def _run_leg(mode, table, hosts, trace, pool, oracle, *, buckets,
+             policy, slo_s, window, seed, victim=None, kill_at=None,
+             breaker_reset_s=0.4, table_seed=0) -> dict:
+    """One replay of ``trace`` through a fresh cluster; chaos legs
+    (victim set) lose that host at ``kill_at`` — by SIGKILL in
+    multiprocess mode, by injected ``host_drop`` in simulated mode."""
+    injector = None
+    if mode == "simulated":
+        specs = []
+        if victim is not None:
+            specs.append(FaultSpec(kind="host_drop", construction=victim,
+                                   start=kill_at))
+        injector = FaultPlan(specs, seed=seed).injector()
+    seq0 = FLIGHT.recorded
+    cluster, nodes = _build_cluster(
+        mode, table, hosts, oracle=oracle, buckets=buckets,
+        policy=policy, injector=injector,
+        breaker_reset_s=breaker_reset_s, table_seed=table_seed)
+    victim_node = nodes.get(victim) if (nodes and victim) else None
+    try:
+        cluster.warmup()
+        client = _ClusterClient(cluster, pool, injector,
+                                kill_at=kill_at if victim else None,
+                                victim_node=victim_node)
+        lats, done, makespan, _, _ = replay(trace, client.submit,
+                                            window=window)
+        cluster.drain()
+
+        ok_in_slo = sum(1 for (_, _, fut), lat in zip(done, lats)
+                        if getattr(fut, "ok", False) and lat <= slo_s)
+        escapes = 0
+        for a, j, fut in done:  # re-gate final values: escapes must be 0
+            if not getattr(fut, "ok", False):
+                continue
+            if not np.array_equal(fut.result(),
+                                  client.refs_for(j, a.batch)):
+                escapes += 1
+        counters = cluster.counters()
+        # the attribution chain: THIS leg's flight events must contain
+        # the host_drop and the recovery decision that answered it
+        leg_events = [ev for ev in flight_dump()
+                      if ev["seq"] > seq0
+                      and ev["kind"] in ("host_drop", "cluster_recovery")]
+        drops = [ev for ev in leg_events if ev["kind"] == "host_drop"]
+        recoveries = [ev for ev in leg_events
+                      if ev["kind"] == "cluster_recovery"
+                      and ev.get("ok")]
+        attributed = bool(
+            victim is None
+            or (any(ev.get("host") == victim for ev in drops)
+                and any(ev.get("host") == victim
+                        and ev.get("decision") == policy
+                        for ev in recoveries)))
+        total = len(trace)
+        rec = {
+            "mode": mode,
+            "policy": policy,
+            "availability": (round(ok_in_slo / total, 4)
+                             if total else None),
+            "served_ok": ok_in_slo,
+            "arrivals": total,
+            "failed_batches": client.failed_batches,
+            "reserves_after_gate": client.reserves,
+            "makespan_s": round(makespan, 4),
+            "qps": (int(loadgen.total_queries(trace) / makespan)
+                    if makespan else None),
+            **_slo_stats(lats, slo_s),
+            "recovery": {
+                "retries": counters.retries,
+                "failovers": counters.failovers,
+                "breaker_opens": counters.breaker_opens,
+                "engine_restarts": counters.engine_restarts,
+                "swallowed_errors": counters.swallowed_errors,
+            },
+            "decision_counts": dict(cluster.decision_counts),
+            "host_states": {lb: cluster.host_state(lb)
+                            for lb in cluster.hosts},
+            "assignment": {lb: list(g)
+                           for lb, g in cluster.assignment.items()},
+            "gate_escapes": escapes,
+            "drop_attributed": attributed,
+            "flight_events": leg_events,
+        }
+        if victim is not None:
+            rec["victim"] = victim
+            rec["killed_at_arrival"] = kill_at
+        if injector is not None:
+            rec["faults"] = {
+                "plan": FaultPlan(injector.plan.specs,
+                                  seed=injector.plan.seed).as_dict(),
+                "injected": dict(injector.injected),
+            }
+        return rec
+    finally:
+        cluster.close()
+        if nodes:
+            for node in nodes.values():
+                try:
+                    node.kill()
+                except Exception as e:
+                    note_swallowed("cluster.peer_unreachable", e)
+
+
+def multihost_bench(n=4096, entry_size=16, cap=128, prf=0, *,
+                    hosts=4, mode="multiprocess", seed=14,
+                    duration_s=6.0, on_rate=60.0, slo_ms=1000.0,
+                    window=8, distinct=16, breaker_reset_s=0.4,
+                    quiet=False) -> dict:
+    """Baseline + host-death chaos legs over one seeded bursty trace;
+    returns the ``--multihost`` record (``MULTIHOST_r14.json``)."""
+    from ..api import DPF
+    from ..parallel import cluster_net
+    from ..utils.compat import has_cpu_multiprocess
+    from .buckets import Buckets
+
+    FLIGHT.clear()      # scope the embedded flight events to this bench
+    table_seed = seed ^ 0x5107
+    table = cluster_net.make_table(n, entry_size, table_seed)
+    oracle = DPF(prf=prf)
+    oracle.eval_init(table)
+    trace = loadgen.bursty_trace(
+        on_rate=on_rate, off_rate=2.0, on_s=1.0, off_s=2.0,
+        duration_s=duration_s, cap=cap, seed=seed, n=n)
+    slo_s = slo_ms / 1e3
+    buckets = Buckets.default_sizes(cap)
+    pool = _key_pool(oracle, n, distinct, b"multihost")
+    victim = "host%d" % (hosts - 1)
+    kill_at = max(1, len(trace) // 3)
+
+    if mode == "multiprocess":
+        # prove the transport is viable before committing three legs to
+        # it; an unspawnable worker (sandbox, no sockets) degrades to
+        # the simulated tier with the cause on the record
+        try:
+            probe = cluster_net.spawn_cluster(
+                n, entry_size, 1, table_seed=table_seed,
+                prf_method=oracle.prf_method, buckets=buckets,
+                timeout_s=120.0)
+            for node in probe:
+                node.close()
+        except Exception as e:
+            note_swallowed("cluster.peer_unreachable", e)
+            mode = "simulated"
+
+    leg_kw = dict(buckets=buckets, slo_s=slo_s, window=window,
+                  seed=seed, breaker_reset_s=breaker_reset_s,
+                  table_seed=table_seed)
+    baseline = _run_leg(mode, table, hosts, trace, pool, oracle,
+                        policy="reshard", **leg_kw)
+    degrade_leg = _run_leg(mode, table, hosts, trace, pool, oracle,
+                           policy="degrade", victim=victim,
+                           kill_at=kill_at, **leg_kw)
+    reshard_leg = _run_leg(mode, table, hosts, trace, pool, oracle,
+                           policy="reshard", victim=victim,
+                           kill_at=kill_at, **leg_kw)
+
+    chaos_avail = [leg["availability"]
+                   for leg in (degrade_leg, reshard_leg)]
+    total_escapes = (baseline["gate_escapes"]
+                     + degrade_leg["gate_escapes"]
+                     + reshard_leg["gate_escapes"])
+    record = {
+        "metric": "multi-host serving cluster: availability (correct-"
+                  "within-SLO fraction) across a host death — %d hosts "
+                  "over one [%d x %d] table (prf=%d), one host lost at "
+                  "arrival %d/%d, recovery by degrade (front-end spare) "
+                  "and by re-shard over survivors (mode=%s; every "
+                  "merged answer bit-gated against the scalar oracle)"
+                  % (hosts, n, entry_size, prf, kill_at, len(trace),
+                     mode),
+        "value": min(chaos_avail) if all(
+            a is not None for a in chaos_avail) else None,
+        "unit": "availability",
+        "vs_baseline": (round(min(chaos_avail)
+                              / baseline["availability"], 4)
+                        if baseline["availability"]
+                        and all(a is not None for a in chaos_avail)
+                        else None),
+        "baseline": "the identical cluster replaying the identical "
+                    "seeded trace with no host loss",
+        "mode": mode,
+        "hosts": hosts,
+        "has_cpu_multiprocess": has_cpu_multiprocess(),
+        "slo_ms": slo_ms,
+        "trace": {"kind": "bursty", "seed": seed,
+                  "duration_s": duration_s, "on_rate": on_rate,
+                  "arrivals": len(trace),
+                  "queries": loadgen.total_queries(trace),
+                  "cap": cap, "window": window},
+        "victim": victim,
+        "killed_at_arrival": kill_at,
+        "baseline_leg": baseline,
+        "chaos_degrade_leg": degrade_leg,
+        "chaos_reshard_leg": reshard_leg,
+        "swallowed_errors": swallowed_snapshot(),
+        "gate_escapes": total_escapes,
+        "checked": bool(
+            total_escapes == 0
+            and all(a is not None and a >= 0.95 for a in chaos_avail)
+            and degrade_leg["drop_attributed"]
+            and reshard_leg["drop_attributed"]
+            and degrade_leg["decision_counts"]["degrade"] >= 1
+            and reshard_leg["decision_counts"]["reshard"] >= 1),
+    }
+    record["obs"] = record_sections()
+    if not record["checked"]:
+        # a failed gate is exactly what the flight recorder exists to
+        # diagnose: embed the FULL ring (scatter plans, the host_drop,
+        # the recovery decision, every fault with its arrival join key)
+        record["obs"]["flight_on_gate_failure"] = flight_dump()
+        print("multihost gate FAILED — full flight dump embedded in "
+              "record (obs.flight_on_gate_failure, %d events)"
+              % len(record["obs"]["flight_on_gate_failure"]),
+              file=sys.stderr, flush=True)
+    if not quiet:
+        print(json.dumps(record), flush=True)
+    return record
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--n", type=int, default=4096)
+    ap.add_argument("--entry-size", type=int, default=16)
+    ap.add_argument("--cap", type=int, default=128)
+    ap.add_argument("--prf", type=int, default=0,
+                    help="PRF id (default 0=DUMMY; 2=ChaCha20, "
+                         "3=AES128)")
+    ap.add_argument("--hosts", type=int, default=4,
+                    help="serving hosts (power of two dividing n)")
+    ap.add_argument("--seed", type=int, default=14)
+    ap.add_argument("--duration", type=float, default=6.0,
+                    help="trace duration in seconds")
+    ap.add_argument("--on-rate", type=float, default=60.0,
+                    help="burst arrival rate (arrivals/sec in ON "
+                         "windows)")
+    ap.add_argument("--slo-ms", type=float, default=1000.0)
+    ap.add_argument("--simulate", action="store_true",
+                    help="force the in-process simulation tier")
+    ap.add_argument("--multiprocess", action="store_true",
+                    help="force one OS process per host (default; "
+                         "falls back to --simulate when workers can't "
+                         "spawn)")
+    ap.add_argument("--dryrun", action="store_true",
+                    help="tiny trace/table smoke (CI): exercises every "
+                         "leg in seconds, makes no perf claims")
+    ap.add_argument("--out", help="also write the JSON record to a file")
+    args = ap.parse_args(argv)
+    if args.simulate and args.multiprocess:
+        ap.error("--simulate and --multiprocess are mutually exclusive")
+    mode = "simulated" if args.simulate else "multiprocess"
+    if args.dryrun:
+        record = multihost_bench(n=512, entry_size=8, cap=16,
+                                 prf=args.prf, hosts=min(args.hosts, 4),
+                                 mode=mode, seed=args.seed,
+                                 duration_s=1.5, on_rate=20.0,
+                                 slo_ms=args.slo_ms, distinct=8,
+                                 breaker_reset_s=0.2)
+    else:
+        record = multihost_bench(n=args.n, entry_size=args.entry_size,
+                                 cap=args.cap, prf=args.prf,
+                                 hosts=args.hosts, mode=mode,
+                                 seed=args.seed,
+                                 duration_s=args.duration,
+                                 on_rate=args.on_rate,
+                                 slo_ms=args.slo_ms)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    return record
+
+
+if __name__ == "__main__":
+    main()

@@ -10,7 +10,7 @@ supplies both sides of the failure story:
 **Injection** — ``FaultPlan`` (a list of ``FaultSpec``) + seed compiles
 into a ``FaultInjector`` consulted at first-class injection points in
 ``ServingEngine.submit``/``_resolve_one``/``warmup`` and (via the
-engines) ``SchemeRouter.submit``.  Five fault kinds, each targetable by
+engines) ``SchemeRouter.submit``.  Six fault kinds, each targetable by
 construction x bucket x arrival-index window with a per-consult
 probability:
 
@@ -22,7 +22,13 @@ probability:
   doubles as an integrity check),
 * ``engine_death``    — the CURRENT engine object is poisoned: every
   subsequent dispatch/warmup on it raises ``EngineDead`` until the
-  supervisor rebuilds a fresh engine over the same prepared server.
+  supervisor rebuilds a fresh engine over the same prepared server,
+* ``host_drop``       — a whole serving HOST dies (the cluster tier's
+  fault, ``parallel/cluster.py``): the targeted host's engine is
+  poisoned like ``engine_death`` but raises ``HostDropped`` and its
+  heartbeats (``on_heartbeat``) fail too, so liveness sweeps detect the
+  loss even between dispatches.  Target by ``construction`` = the host
+  label ("host0", ...).
 
 Decisions are **deterministic under the plan seed**: each consult draws
 from ``np.random.default_rng((seed, spec_index, arrival, consult))``,
@@ -57,7 +63,7 @@ from .engine import LoadShed, ServingEngine
 
 #: fault kinds a FaultSpec can name
 KINDS = ("dispatch_error", "compile_error", "latency", "corrupt_shares",
-         "engine_death")
+         "engine_death", "host_drop")
 
 
 class FaultError(RuntimeError):
@@ -76,6 +82,15 @@ class InjectedCompileError(FaultError):
 class EngineDead(FaultError):
     """The engine object is poisoned (``kind="engine_death"``): every
     dispatch raises until the supervisor rebuilds a fresh engine."""
+
+
+class HostDropped(EngineDead):
+    """A whole serving host died (``kind="host_drop"``): every engine on
+    it is gone at once and its heartbeats stop.  Subclasses
+    ``EngineDead`` so engine-level recovery (router exclusion,
+    supervisor notify) applies unchanged; the cluster tier
+    (``parallel/cluster.py``) additionally takes the host out of the
+    scatter plan and re-shards or degrades."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -162,7 +177,9 @@ class FaultInjector:
     # ------------------------------------------------------ decisions
 
     def _fires_left(self, idx: int, spec: FaultSpec) -> bool:
-        cap = 1 if spec.kind == "engine_death" else spec.max_fires
+        # death faults poison persistent state — once is the event
+        cap = (1 if spec.kind in ("engine_death", "host_drop")
+               else spec.max_fires)
         return cap is None or self._fires.get(idx, 0) < cap
 
     def _decide(self, idx: int, spec: FaultSpec) -> bool:
@@ -212,8 +229,13 @@ class FaultInjector:
         label = getattr(engine, "label", None)
         if id(engine) in self._dead:
             raise EngineDead("engine %r is dead (injected)" % (label,))
-        for spec in self._firing(("engine_death",), label, bucket):
+        for spec in self._firing(("engine_death", "host_drop"), label,
+                                 bucket):
             self._dead.add(id(engine))
+            if spec.kind == "host_drop":
+                raise HostDropped(
+                    "host %r dropped at arrival %d (injected)"
+                    % (label, self.arrival))
             raise EngineDead("engine %r killed at arrival %d (injected)"
                              % (label, self.arrival))
         for spec in self._firing(("latency",), label, bucket):
@@ -247,6 +269,21 @@ class FaultInjector:
             raise InjectedCompileError(
                 "precompile failed for %r bucket %d (injected)"
                 % (label, bucket))
+
+    def on_heartbeat(self, engine) -> None:
+        """Consulted by the cluster tier's liveness sweep
+        (``ClusterRouter.check_hosts``): a ``host_drop`` spec fires here
+        too — with ``bucket=None`` targeting, heartbeats and dispatches
+        share the spec — and an already-dropped host's heartbeat keeps
+        failing, so host loss is detectable between dispatches."""
+        label = getattr(engine, "label", None)
+        if id(engine) in self._dead:
+            raise HostDropped("host %r is down (injected)" % (label,))
+        for _ in self._firing(("host_drop",), label, None):
+            self._dead.add(id(engine))
+            raise HostDropped(
+                "host %r dropped at arrival %d (injected, heartbeat)"
+                % (label, self.arrival))
 
     def is_dead(self, engine) -> bool:
         return id(engine) in self._dead

@@ -396,3 +396,137 @@ def tune_router(table, *, prf_method: int = 0, cap: int | None = None,
     }
     cache.store(key, record)
     return {**record, "searched": True}
+
+
+# -------------------------------------------------------- cluster scatter
+
+
+def cluster_cache_key(*, n: int, entry_size: int, batch: int,
+                      prf_method: int, hosts: int) -> str:
+    """Tuning-cache key for the multi-host scatter knobs.  The host
+    count rides in the mesh tag slot ("h<H>"): a 2-host and an 8-host
+    cluster scatter the same table very differently (per-host granule
+    size changes the per-dispatch work), so their knobs must not be
+    confused — same grammar move as the mesh-tagged serve keys."""
+    return cache_key("cluster", n=n, entry_size=entry_size, batch=batch,
+                     prf_method=prf_method, scheme="logn", radix=2,
+                     mesh="h%d" % int(hosts))
+
+
+def lookup_cluster_knobs(*, n: int, entry_size: int, hosts: int,
+                         prf_method: int, cap: int,
+                         cache: TuningCache | None = None) -> dict | None:
+    """Tuned (buckets, max_in_flight) for this cluster shape, or None.
+    ``ClusterRouter.local`` consults this when knobs are not pinned.
+    Never raises — an unreadable cache is a miss."""
+    try:
+        cache = cache if cache is not None else default_cache()
+        rec = cache.lookup(cluster_cache_key(
+            n=int(n), entry_size=int(entry_size), batch=int(cap),
+            prf_method=int(prf_method), hosts=int(hosts)))
+        return rec.get("knobs") if rec else None
+    except Exception:  # pragma: no cover — cache must never break serving
+        return None
+
+
+def tune_cluster(table, *, hosts: int = 2, prf_method: int = 0,
+                 cap: int | None = None, trace=None,
+                 trace_kind: str | None = None,
+                 trace_kw: dict | None = None, in_flight=(1, 2),
+                 ladders=None, reps: int = 2, distinct: int = 8,
+                 cache: TuningCache | None = None, force: bool = False,
+                 log=None) -> dict:
+    """Tune the cluster front-end's scatter knobs against a trace.
+
+    Grid-searches (bucket ladder x ``max_in_flight``) for a simulated
+    ``parallel.cluster.ClusterRouter`` over ``table`` — the in-process
+    tier runs the identical scatter/merge code the multiprocess tier
+    does, so its knob ranking transfers.  Every candidate's every
+    merged answer is equality-gated against the scalar oracle
+    (``DPF.eval_cpu``); the winner persists under the ``cluster|...``
+    key.  Like the other tuners, an explicit trace re-measures.
+    """
+    import dpf_tpu
+    from ..parallel.cluster import ClusterRouter
+    from ..serve.buckets import Buckets
+
+    cache = cache if cache is not None else default_cache()
+    table = np.asarray(table, dtype=np.int32)
+    n, entry_size = table.shape
+    cap = int(cap or min(dpf_tpu.DPF.BATCH_SIZE, 512))
+    key = cluster_cache_key(n=n, entry_size=entry_size, batch=cap,
+                            prf_method=prf_method, hosts=hosts)
+    if not force and trace is None and trace_kind is None:
+        rec = cache.lookup(key)
+        if rec is not None:
+            return {**rec, "searched": False}
+
+    trace = resolve_trace(cap, trace, trace_kind, trace_kw)
+    if max(trace) > cap:
+        raise ValueError("trace batch %d exceeds cap %d"
+                         % (max(trace), cap))
+    total = sum(trace)
+    oracle = dpf_tpu.DPF(prf=prf_method)
+    oracle.eval_init(table)
+    ks = [oracle.gen((i * 0x9E3779B1) % n, n,
+                     seed=b"cluster-tune-%d" % i)[0]
+          for i in range(distinct)]
+    refs = oracle.eval_cpu(ks)
+    stream = [([ks[(j + i) % distinct] for i in range(b)],
+               [(j + i) % distinct for i in range(b)])
+              for j, b in enumerate(trace)]
+
+    candidates = []
+    for ladder in (ladders if ladders is not None
+                   else Buckets.ladder_candidates(cap)):
+        for mif in in_flight:
+            candidates.append((tuple(ladder), int(mif)))
+    best = None
+    tried = rejected = 0
+    for ladder, mif in candidates:
+        tried += 1
+        try:
+            elapsed, stats = float("inf"), None
+            for _ in range(reps):
+                c = ClusterRouter.local(
+                    table, hosts=hosts, oracle=oracle, buckets=ladder,
+                    engine_kw={"max_in_flight": mif})
+                c.warmup()
+                t0 = time.perf_counter()
+                outs = [(idxs, c.submit(keys)) for keys, idxs in stream]
+                for _, fut in outs:
+                    fut.result()
+                rep_s = time.perf_counter() - t0
+                if rep_s < elapsed:
+                    elapsed, stats = rep_s, c.stats()
+                for idxs, fut in outs:    # gate every rep's answers
+                    if not np.array_equal(fut.result(), refs[idxs]):
+                        raise AssertionError("merged shares diverged")
+        except Exception as exc:
+            rejected += 1
+            if log:
+                log("  reject (%s): %s mif=%d"
+                    % (type(exc).__name__, ladder, mif))
+            continue
+        if log:
+            log("  ladder=%s mif=%d -> %d qps"
+                % (list(ladder), mif, int(total / elapsed)))
+        if best is None or elapsed < best[0]:
+            best = (elapsed, ladder, mif, stats)
+    if best is None:
+        raise AssertionError("no cluster candidate passed the gate")
+    elapsed, ladder, mif, stats = best
+    record = {
+        "knobs": {"buckets": list(ladder), "max_in_flight": mif},
+        "measured": {
+            "elapsed_s": round(elapsed, 6),
+            "qps": int(total / elapsed),
+            "trace": trace, "cap": cap, "hosts": hosts, "reps": reps,
+            "candidates_tried": tried, "rejected": rejected,
+            "cluster_stats": stats,
+        },
+        "fingerprint": device_fingerprint(),
+        "gated": True,  # every merged share matched the eval_cpu oracle
+    }
+    cache.store(key, record)
+    return {**record, "searched": True}

@@ -1,0 +1,271 @@
+"""Multi-host serving cluster (simulation tier): granule-plan math,
+shard-server partial-share parity, scatter/gather serving equality, the
+host-drop -> reshard/degrade recovery state machine (dispatch-,
+heartbeat- and breaker-detected), hot-standby promotion, flight-recorder
+attribution, and the cluster observability surface.
+
+Everything here runs single-process — ``ClusterRouter.local`` builds
+in-process ``LocalHost`` nodes exercising the identical state machine
+the socket tier (tests/test_cluster_worker.py) runs across OS
+processes.
+"""
+
+import numpy as np
+import pytest
+
+from dpf_tpu import DPF
+from dpf_tpu.core import expand, keygen
+from dpf_tpu.obs.flight import FLIGHT, flight_dump
+from dpf_tpu.parallel.cluster import (ClusterRouter, ClusterShardServer,
+                                      ClusterUnavailable, HostUnreachable,
+                                      granule_rows, make_plan,
+                                      reshard_plan)
+from dpf_tpu.serve.faults import FaultPlan, FaultSpec
+
+
+def _setup(n=256, entry=5):
+    dpf = DPF(prf=DPF.PRF_DUMMY)
+    table = np.random.default_rng(7).integers(
+        -2 ** 31, 2 ** 31, (n, entry), dtype=np.int64).astype(np.int32)
+    dpf.eval_init(table)
+    keys = [dpf.gen((i * 41) % n, n, seed=b"cluster-%d" % i)[0]
+            for i in range(12)]
+    return dpf, table, keys
+
+
+def _batch(keys, b, j=0):
+    return [keys[(j + i) % len(keys)] for i in range(b)]
+
+
+def _drop_plan(victim, at, seed=3):
+    return FaultPlan([FaultSpec(kind="host_drop", construction=victim,
+                                start=at)], seed=seed).injector()
+
+
+# ------------------------------------------------------------- planning
+
+def test_granule_plan_math():
+    assert granule_rows(256, 4) == 64
+    assert granule_rows(16, 1) == 16
+    with pytest.raises(ValueError):
+        granule_rows(256, 3)           # hosts not pow2
+    with pytest.raises(ValueError):
+        granule_rows(256, 512)         # hosts > n
+    assert make_plan(256, 4) == {"host0": (0,), "host1": (64,),
+                                 "host2": (128,), "host3": (192,)}
+
+
+def test_reshard_plan_round_robin():
+    adds = reshard_plan((192, 0, 64), ["host1", "host2"])
+    assert adds == {"host1": (0, 192), "host2": (64,)}
+    with pytest.raises(ValueError):
+        reshard_plan((0,), [])
+
+
+# --------------------------------------------------- shard-server parity
+
+def test_shard_partials_sum_to_full_answer():
+    """Partial shares over disjoint granules wrap-sum to the one-host
+    answer — the invariant the whole cluster merge rests on."""
+    dpf, table, keys = _setup()
+    perm = expand.permute_table(table)
+    pk = keygen.decode_keys_batched(_batch(keys, 4))
+    ref = np.asarray(dpf.eval_tpu(_batch(keys, 4)))
+    parts = []
+    for row0 in range(0, 256, 64):
+        srv = ClusterShardServer(perm, (row0,), 64,
+                                 prf_method=DPF.PRF_DUMMY)
+        parts.append(np.asarray(srv._dispatch_packed(pk)))
+    out = parts[0].astype(np.int32)
+    with np.errstate(over="ignore"):
+        for p in parts[1:]:
+            out = out + p.astype(np.int32)
+    assert np.array_equal(out, ref)
+
+
+def test_shard_server_granule_management():
+    _, table, _ = _setup(n=128)
+    perm = expand.permute_table(table)
+    srv = ClusterShardServer(perm, (0,), 32, prf_method=DPF.PRF_DUMMY)
+    srv.add_granules((64, 0))          # dedup + sort
+    assert srv.granules == (0, 64)
+    srv.set_granules((96,))            # hot-standby promotion swap
+    assert srv.granules == (96,)
+    with pytest.raises(ValueError):
+        srv.add_granules((7,))         # not a granule boundary
+    srv.set_granules(())
+    with pytest.raises(RuntimeError):  # no granules: refuses, checked
+        srv._dispatch_packed(None)     # before the batch is touched
+
+
+# --------------------------------------------------------- serve parity
+
+def test_cluster_serves_bit_identical_answers():
+    dpf, table, keys = _setup()
+    c = ClusterRouter.local(table, hosts=4, oracle=dpf,
+                            buckets=(4, 8))
+    try:
+        c.warmup()
+        for j, b in enumerate([1, 4, 8, 3]):
+            batch = _batch(keys, b, j)
+            out = c.submit(batch).result()
+            assert np.array_equal(out, np.asarray(dpf.eval_tpu(batch)))
+        assert c.host_state("host0") == "live"
+        assert set(c.assignment) == {"host%d" % i for i in range(4)}
+    finally:
+        c.close()
+
+
+# ------------------------------------------------------------- recovery
+
+def _run_drop(policy, *, standby=False, hosts=4):
+    """Shared chassis: kill host<last> at arrival 2 of 6, assert
+    bit-exact answers before/through/after the loss, return the router
+    for per-policy state assertions."""
+    dpf, table, keys = _setup()
+    victim = "host%d" % (hosts - 1)
+    inj = _drop_plan(victim, at=2)
+    c = ClusterRouter.local(table, hosts=hosts, oracle=dpf,
+                            buckets=(4, 8), injector=inj,
+                            policy=policy, standby=standby,
+                            breaker_reset_s=60.0)
+    c.warmup()
+    try:
+        for j in range(6):
+            inj.begin_arrival(j)
+            batch = _batch(keys, 4, j)
+            out = c.submit_resilient(batch).result()
+            assert np.array_equal(out, np.asarray(dpf.eval_tpu(batch))), \
+                "arrival %d diverged" % j
+        assert c.host_state(victim) == "down"
+        assert c.assignment[victim] == ()
+        return dpf, c, victim
+    finally:
+        c.close()
+
+
+def test_host_drop_reshard_restores_coverage():
+    _, c, victim = _run_drop("reshard")
+    assert c.decision_counts == {"reshard": 1, "degrade": 0}
+    assert c.spare is None
+    moved = [g for lb, g in c.assignment.items() if lb != victim]
+    assert sorted(sum(moved, ())) == list(range(0, 256, 64))
+    assert c.recovery.engine_restarts == 1
+    evs = [e for e in flight_dump()
+           if e["kind"] == "cluster_recovery" and e["host"] == victim]
+    assert evs and evs[-1]["decision"] == "reshard" and evs[-1]["ok"]
+
+
+def test_host_drop_degrades_to_spare():
+    _, c, victim = _run_drop("degrade")
+    assert c.decision_counts == {"reshard": 0, "degrade": 1}
+    assert c.spare is not None and c.assignment["spare"] == (192,)
+    assert c.host_state("spare") == "live"
+    assert c.recovery.failovers == 1
+    evs = [e for e in flight_dump()
+           if e["kind"] == "host_drop" and e["host"] == victim]
+    assert evs, "the loss itself must be on the flight record"
+
+
+def test_hot_standby_prewarmed_then_promoted():
+    dpf, table, keys = _setup()
+    c = ClusterRouter.local(table, hosts=4, oracle=dpf, buckets=(4, 8),
+                            policy="degrade", standby=True)
+    try:
+        # standby exists, holds only the warmup placeholder, and is NOT
+        # in the scatter plan (it would double-count granule 0)
+        assert c.spare is not None and c.spare.granules == (0,)
+        assert "spare" not in c.assignment
+        assert c.host_state("spare") == "down"
+        batch = _batch(keys, 4)
+        assert np.array_equal(c.submit(batch).result(),
+                              np.asarray(dpf.eval_tpu(batch)))
+        c._handle_drop("host2", RuntimeError("synthetic loss"))
+        assert c.spare.granules == (128,)       # placeholder swapped out
+        assert c.assignment["spare"] == (128,)
+        assert np.array_equal(c.submit(batch).result(),
+                              np.asarray(dpf.eval_tpu(batch)))
+    finally:
+        c.close()
+
+
+def test_heartbeat_sweep_detects_drop():
+    dpf, table, keys = _setup()
+    inj = _drop_plan("host1", at=1)
+    c = ClusterRouter.local(table, hosts=2, oracle=dpf, buckets=(4, 8),
+                            injector=inj, policy="auto")
+    try:
+        inj.begin_arrival(1)
+        states = c.check_hosts()
+        assert states["host1"] == "down" and states["host0"] == "live"
+        # auto with a survivor resolves to reshard
+        assert c.decision_counts["reshard"] == 1
+        batch = _batch(keys, 4)
+        assert np.array_equal(c.submit(batch).result(),
+                              np.asarray(dpf.eval_tpu(batch)))
+    finally:
+        c.close()
+
+
+def test_degrade_without_table_is_unavailable():
+    dpf, table, keys = _setup(n=128)
+    c = ClusterRouter.local(table, hosts=2, oracle=dpf, buckets=(4,),
+                            policy="degrade")
+    c._table_perm = None               # simulate a table-less front-end
+    with pytest.raises(ClusterUnavailable):
+        c._handle_drop("host0", HostUnreachable("synthetic"))
+    # the failed recovery is itself on the record
+    evs = [e for e in flight_dump() if e["kind"] == "cluster_recovery"
+           and e["host"] == "host0"]
+    assert evs and evs[-1]["ok"] is False
+
+
+# -------------------------------------------------------- observability
+
+def test_cluster_counters_merge_hosts_and_recovery():
+    _, c, _ = _run_drop("degrade")
+    agg = c.counters()
+    # per-host engines each served batches; the merge must see them all
+    per_host = sum(c.hosts[lb].counters().batches_submitted
+                   for lb in c.hosts)
+    assert agg.batches_submitted >= per_host > 0
+    assert agg.failovers == 1
+
+
+def test_cluster_metrics_registered_with_process_labels():
+    from dpf_tpu.obs.metrics import REGISTRY
+    _, c, victim = _run_drop("reshard")
+    text = REGISTRY.openmetrics()
+    assert "dpf_cluster_host_state" in text
+    assert 'host="%s"' % victim in text
+    assert 'process="' in text
+    assert "dpf_cluster_recoveries" in text
+
+
+def test_flight_events_carry_the_attribution_chain():
+    seq0 = FLIGHT.recorded
+    _, c, victim = _run_drop("reshard")
+    evs = [e for e in flight_dump() if e["seq"] > seq0]
+    kinds = [e["kind"] for e in evs]
+    assert "scatter" in kinds
+    drop = next(e for e in evs if e["kind"] == "host_drop")
+    rec = next(e for e in evs if e["kind"] == "cluster_recovery")
+    assert drop["host"] == victim == rec["host"]
+    assert rec["decision"] == "reshard" and rec["granules"] == [192]
+    assert drop["seq"] < rec["seq"], "loss precedes the decision"
+
+
+# ------------------------------------------------- bench state machine
+
+def test_multihost_bench_simulated_smoke():
+    """The --multihost bench's state machine, single-process and tiny:
+    the tier-1 stand-in for the skip-gated multiprocess rehearsal."""
+    from dpf_tpu.serve.bench_multihost import multihost_bench
+    rec = multihost_bench(n=128, entry_size=4, cap=8, prf=0, hosts=2,
+                          mode="simulated", duration_s=0.6, on_rate=15.0,
+                          distinct=4, breaker_reset_s=0.2, quiet=True)
+    assert rec["checked"], rec.get("gate_escapes")
+    assert rec["gate_escapes"] == 0
+    for leg in ("chaos_degrade_leg", "chaos_reshard_leg"):
+        assert rec[leg]["availability"] >= 0.95
+        assert rec[leg]["drop_attributed"]
