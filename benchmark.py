@@ -8,6 +8,7 @@
 #   --serve            serve/bench_serve.py         BENCH_SERVE_r06.json
 #   --autotune         tune/search.autotune_sweep   BENCH_TUNE_r07.json
 #   --autotune-scheme  tune/search.scheme_sweep     BENCH_SCHEME_r13.json
+#   --autotune-kernel  tune/kernel_search           BENCH_KSEARCH_r15.json
 #   --batch-pir        serve/bench_pir.py           BENCH_PIR_r09.json
 #   --multichip        serve/bench_multichip.py     MULTICHIP_r06.json
 #   --load             serve/bench_load.py          BENCH_LOAD_r10.json
@@ -28,6 +29,17 @@
 # (logn vs radix-4 vs sqrtn) per (N, B) point, each knob-tuned and
 # equality-gated first, and persists the per-shape winning
 # construction in the same tuning cache.
+#
+# --autotune-kernel: one level down — generative search over
+# STRUCTURED kernel variants of the sqrt-N PRF->contract program
+# (tile shape, VMEM cell budget, grid order/dimension semantics,
+# limb emission, codeword-select fusion for the Pallas family; scan
+# row_chunk x dot_impl for the XLA family), seeded from the staged
+# descent winner, mutate/tournament selection, every timed candidate
+# equality-gated against the scalar oracle and every Pallas variant
+# additionally gated via interpret-mode parity; winners persist as
+# kvariant cache entries that resolve with
+# kernel_resolved_from="searched".  See docs/TUNING.md.
 #
 # --multichip: the mesh rehearsal matrix (all three constructions x
 # every mesh split x shape through the mesh autotuner) on a forced-
@@ -103,6 +115,44 @@ def _autotune_main(argv):
                    out=args.out)
 
 
+def _autotune_kernel_main(argv):
+    import argparse
+
+    from dpf_tpu.tune.kernel_search import kernel_search_sweep
+    from dpf_tpu.tune.search import DEFAULT_SWEEP
+
+    ap = argparse.ArgumentParser(
+        description="generative kernel-variant search over the "
+                    "PRF->contract kernel space (docs/TUNING.md)")
+    ap.add_argument("--shapes", default=None,
+                    help="comma list of N:B points (default %s)"
+                         % ",".join("%d:%d" % s for s in DEFAULT_SWEEP))
+    ap.add_argument("--prf", type=int, default=2,
+                    help="PRF id (default 2=ChaCha20 — the Pallas "
+                         "family needs a plane-core PRF; 0=DUMMY, "
+                         "3=AES128 time the XLA family only)")
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--generations", type=int, default=3)
+    ap.add_argument("--population", type=int, default=6)
+    ap.add_argument("--force", action="store_true",
+                    help="re-search even with a warm kvariant cache")
+    ap.add_argument("--dryrun", action="store_true",
+                    help="tiny shapes + search budget smoke (CI): same "
+                         "record shape and invariants (0 rejections, "
+                         "0 gate escapes, persisted winner), no perf "
+                         "claims")
+    ap.add_argument("--out", help="also write the JSON record to a file")
+    args = ap.parse_args(argv)
+    shapes = None
+    if args.shapes:
+        shapes = tuple(tuple(int(x) for x in p.split(":"))
+                       for p in args.shapes.split(","))
+    kernel_search_sweep(shapes, prf_method=args.prf, reps=args.reps,
+                        generations=args.generations,
+                        population=args.population, force=args.force,
+                        dryrun=args.dryrun, out=args.out)
+
+
 def _autotune_scheme_main(argv):
     import argparse
 
@@ -157,6 +207,10 @@ if __name__ == "__main__":
     if "--trace" in sys.argv:
         from dpf_tpu.obs.bench_trace import main
         main([a for a in sys.argv[1:] if a != "--trace"])
+        sys.exit(0)
+    if "--autotune-kernel" in sys.argv:
+        _autotune_kernel_main(
+            [a for a in sys.argv[1:] if a != "--autotune-kernel"])
         sys.exit(0)
     if "--autotune-scheme" in sys.argv:
         _autotune_scheme_main(

@@ -517,10 +517,17 @@ class DPF(object):
         ``set_dot_impl``/``apply_globals`` stay live between dispatches.
 
         scheme='sqrtn' resolves its own knob space (``dot_impl``,
-        ``row_chunk``, ``kernel_impl``) under the same precedence,
-        plus ``kernel_resolved_from`` provenance ("config" | "tuned" |
-        "heuristic" | "degraded" — the last when a resolved "pallas"
-        has no Pallas/TPU here and the xla scan answers instead);
+        ``row_chunk``, ``kernel_impl``) under the same precedence with
+        one extra rung: a SEARCHED kernel variant (``tune/
+        kernel_search.py``'s ``kvariant`` cache entries) outranks the
+        staged-descent knobs — provenance ``kernel_resolved_from`` is
+        "config" | "searched" | "tuned" | "heuristic" | "degraded"
+        (the last when a resolved "pallas" has no Pallas/TPU here and
+        the xla scan answers instead).  A searched resolution carries
+        the serialized variant under ``kernel_variant``; a "pallas"
+        resolution also reports ``row_chunk_effective`` — the chunk the
+        grid kernel will actually run after its VMEM cell cap, with a
+        halved request counted at ``api.sqrt_row_chunk_halved``.
         ``row_chunk`` may come back None — the dispatch path resolves
         it against the decoded batch's key split
         (``sqrtn.clamp_row_chunk``).
@@ -549,6 +556,19 @@ class DPF(object):
                     n=n, entry_size=self.table_effective_entry_size,
                     batch=batch, prf_method=self.prf_method,
                     scheme=self.scheme, radix=self.radix) or {}
+                if self.scheme == "sqrtn":
+                    # searched kernel variants (tune/kernel_search.py)
+                    # live under their own "kvariant" entry kind and
+                    # ride in the memo's reserved "_searched" slot —
+                    # a tuner's measurement pin (a bare knob dict)
+                    # never carries one, so a pinned candidate is
+                    # timed as itself, not hijacked by a prior search
+                    from .tune.cache import lookup_kernel_variant
+                    searched = lookup_kernel_variant(
+                        n=n, entry_size=self.table_effective_entry_size,
+                        batch=batch, prf_method=self.prf_method)
+                    if searched:
+                        tuned = {**tuned, "_searched": searched}
             else:
                 tuned = {}
             self._tuned_cache[batch] = tuned
@@ -570,13 +590,21 @@ class DPF(object):
             # of raising (kernel_resolved_from="degraded", counted via
             # note_swallowed) so a tuning cache written on a TPU stays
             # usable on this machine
+            searched = tuned.get("_searched") or {}
             explicit_k = cfg.kernel_impl if cfg is not None else None
             if not is_auto(explicit_k):
                 kernel, kernel_from = explicit_k, "config"
+            elif searched.get("kernel_impl") is not None:
+                # a searched kernel variant (tune/kernel_search.py)
+                # outranks the staged-descent knobs: it was seeded FROM
+                # them and equality-gated, so it is never a regression
+                kernel, kernel_from = searched["kernel_impl"], "searched"
             elif tuned.get("kernel_impl") is not None:
                 kernel, kernel_from = tuned["kernel_impl"], "tuned"
             else:
                 kernel, kernel_from = "xla", "heuristic"
+            variant = (searched.get("kernel_variant")
+                       if kernel_from == "searched" else None)
             if kernel == "pallas":
                 from .utils.compat import has_pallas_sqrt_kernel
                 if not has_pallas_sqrt_kernel():
@@ -586,22 +614,62 @@ class DPF(object):
                         RuntimeError(
                             "kernel_impl='pallas' (from %s) but Pallas/"
                             "TPU is unavailable here" % kernel_from))
-                    kernel, kernel_from = "xla", "degraded"
-            row_chunk = pick("row_chunk", None)
-            if (row_chunk is not None
-                    and (cfg is None or is_auto(cfg.row_chunk))
-                    and tuned.get("kernel_impl", "xla") != kernel):
-                # the tuner gated (row_chunk, kernel) together — a
-                # tuned row_chunk rides only with ITS kernel (the logn
-                # chunk_leaves rule); the winning kernel falls back to
-                # its own heuristic/VMEM clamp at dispatch
-                row_chunk = None
-            return {
-                "dot_impl": pick("dot_impl", matmul128.default_impl()),
+                    kernel, kernel_from, variant = "xla", "degraded", None
+            if kernel_from == "searched":
+                # the searched (row_chunk, dot_impl) were gated with
+                # THEIR kernel; a tuned row_chunk never mixes in
+                row_chunk = (cfg.row_chunk
+                             if cfg is not None
+                             and not is_auto(cfg.row_chunk)
+                             else searched.get("row_chunk"))
+            else:
+                row_chunk = pick("row_chunk", None)
+                if (row_chunk is not None
+                        and (cfg is None or is_auto(cfg.row_chunk))
+                        and tuned.get("kernel_impl", "xla") != kernel):
+                    # the tuner gated (row_chunk, kernel) together — a
+                    # tuned row_chunk rides only with ITS kernel (the
+                    # logn chunk_leaves rule); the winning kernel falls
+                    # back to its own heuristic/VMEM clamp at dispatch
+                    row_chunk = None
+            if kernel_from == "searched" and (
+                    cfg is None or is_auto(cfg.dot_impl)):
+                dot = searched.get("dot_impl") or matmul128.default_impl()
+            else:
+                dot = pick("dot_impl", matmul128.default_impl())
+            out = {
+                "dot_impl": dot,
                 "row_chunk": row_chunk,
                 "kernel_impl": kernel,
                 "kernel_resolved_from": kernel_from,
             }
+            # extra provenance keys appear ONLY for searched/pallas
+            # resolutions, so pre-variant cache entries resolve to the
+            # exact pre-variant dict
+            if variant is not None:
+                out["kernel_variant"] = variant
+            if kernel == "pallas":
+                # the effective row chunk the grid kernel will RUN
+                # (the VMEM cell cap halves over-large requests —
+                # ops/pallas_sqrt.pallas_sqrt_row_chunk); surfacing it
+                # here means the cache entry's claim and the kernel's
+                # reality can no longer silently diverge
+                from .core import sqrtn as _sqrtn
+                from .ops.pallas_sqrt import pallas_sqrt_row_chunk
+                _k, _r = _sqrtn.default_split(n)
+                eff = pallas_sqrt_row_chunk(
+                    _r, _k, row_chunk,
+                    (variant or {}).get("max_cells"))
+                out["row_chunk_effective"] = eff
+                if row_chunk is not None and eff != row_chunk:
+                    from .utils.profiling import note_swallowed
+                    note_swallowed(
+                        "api.sqrt_row_chunk_halved",
+                        RuntimeError(
+                            "requested sqrt row_chunk %d (from %s) "
+                            "halved to %d by the VMEM cell cap"
+                            % (row_chunk, kernel_from, eff)))
+            return out
 
         kernel_impl = pick("kernel_impl", "xla")
         if cfg is not None and cfg.chunk_leaves:
@@ -711,7 +779,8 @@ class DPF(object):
         return sqrtn.eval_contract_batched(
             pk.seeds, pk.cw1, pk.cw2, self.table_device,
             prf_method=self.prf_method, dot_impl=kn["dot_impl"],
-            row_chunk=rc, kernel_impl=kernel)
+            row_chunk=rc, kernel_impl=kernel,
+            kernel_variant=kn.get("kernel_variant"))
 
     def _mixed_batch(self, keys):
         """Deserialize + validate a radix-4 key batch (uniform n)."""

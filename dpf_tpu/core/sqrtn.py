@@ -539,7 +539,8 @@ def _resolve_row_chunk(r: int, k: int, bsz: int,
 def eval_contract_batched(seeds, cw1, cw2, table, *, prf_method: int,
                           dot_impl: str = "i32",
                           row_chunk: int | None = None,
-                          kernel_impl: str | None = "xla"):
+                          kernel_impl: str | None = "xla",
+                          kernel_variant=None):
     """Fused batched sqrt-N evaluation: one device program for the whole
     batch — row-chunked [B, rc, K] PRF grid slabs scanned over the R
     rows, LSB codeword select, 128-bit add, exact mod-2^32 contraction
@@ -566,12 +567,22 @@ def eval_contract_batched(seeds, cw1, cw2, table, *, prf_method: int,
     the latency-friendly construction for mid-sized tables (the role the
     reference's coop kernel plays for single queries,
     ``dpf_gpu/dpf_coop.cu:3-9``).
+
+    ``kernel_variant`` (pallas only) is a searched structural variant —
+    a dict of ``ops.pallas_sqrt`` launcher keywords (``tb``,
+    ``max_cells``, ``grid_order``, ``dim_semantics``, ``limbs``,
+    ``cw_add``) as produced by ``tune/kernel_search.py``; every variant
+    is bit-identical to the scan oracle, so this only changes the
+    schedule, never the answer.  Ignored on the xla path (its searched
+    fields, ``row_chunk``/``dot_impl``, are native arguments here).
     """
     if (kernel_impl or "xla") == "pallas":
         from ..ops import pallas_sqrt
+        kv = {k: v for k, v in dict(kernel_variant or {}).items()
+              if k in pallas_sqrt._VARIANT_FIELDS and v is not None}
         return pallas_sqrt.sqrt_grid_contract_pallas(
             seeds, cw1, cw2, table, prf_method=prf_method,
-            row_chunk=row_chunk)
+            row_chunk=row_chunk, **kv)
     bsz, k = seeds.shape[0], seeds.shape[1]
     r = cw1.shape[1]
     row_chunk = _resolve_row_chunk(r, k, bsz, row_chunk)

@@ -27,6 +27,22 @@ Only the low 32 output bits are contracted, and 128-bit adds carry
 upward only, so the codeword add needs just the low limb — the kernel
 ships ``cw*[..., 0]`` planes and skips the carry chain entirely.
 
+**Kernel variants** (the generative-search space, ``tune/
+kernel_search.py``): the structural choices PR 10 hard-coded are now
+parameters — ``tb`` (key-tile height), ``max_cells`` (the VMEM cell
+budget the row chunk halves down to), ``grid_order`` ("bk" = key tiles
+outer / row tiles inner, the reduction-dim default; "kb" = row tiles
+outer, valid only when one key tile covers the batch — revisiting an
+output block from non-adjacent grid steps is not Mosaic-legal),
+``dim_semantics`` (the KEY-tile axis as "parallel" or "arbitrary"; the
+row axis accumulates and is always "arbitrary"), ``limbs`` ("low" =
+low-limb-only codeword add; "multi" = all four value limbs + the full
+128-bit carry chain, the scan path's exact arithmetic — bit-identical
+because carries only propagate upward), and ``cw_add`` ("fused" = the
+``jnp.where`` select; "staged" = base-add-then-masked-correction,
+``cw1 + sel*(cw2-cw1)``, bit-identical mod 2^32).  Every variant is
+equality-gated against the scan oracle before it is ever trusted.
+
 Correctness: asserted against the scan-path oracle in tests (interpret
 mode on CPU, compiled on TPU).  ChaCha20-12/Salsa20-12 cores and their
 block-PRG variants; AES stays on the XLA path (see
@@ -43,10 +59,13 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .pallas_level import _BLK_CORES, _CORES, _compiler_params
+from .pallas_level import (_BLK_CORES, _CORES, _add128_planes,
+                           _compiler_params)
 
 # default tile knobs: widest live state = 16 cipher words x [TB, cells]
-# u32 (the block-PRG ids quarter that — one block per 4 rows)
+# u32 (the block-PRG ids quarter that — one block per 4 rows).  These
+# are the PR-10 hand-tuned values; the kernel search treats them as the
+# seed of the variant space, not the answer.
 PALLAS_SQRT_TB = 32         # key tile (sublane-friendly multiple of 8)
 PALLAS_SQRT_MAX_CELLS = 2048  # rc*K per tile -> ~4 MB cipher state
 
@@ -67,35 +86,51 @@ def pallas_sqrt_unsupported(prf_method: int, r: int) -> str | None:
     return None
 
 
-def pallas_sqrt_row_chunk(r: int, k: int,
-                          row_chunk: int | None = None) -> int:
+def pallas_sqrt_row_chunk(r: int, k: int, row_chunk: int | None = None,
+                          max_cells: int | None = None) -> int:
     """Grid rows per kernel step.  The kernel's live state is the
     ``[TB, rc*K]`` cipher planes in VMEM, so the bound is the CELL count
-    (``PALLAS_SQRT_MAX_CELLS``), not the XLA scan's 64 MiB HBM slab.
-    Explicit/tuned values obey the shared row-chunk rules (divide R,
-    multiple of 4 when chunking — ``sqrtn._resolve_row_chunk``) and are
-    then silently halved down to the cell cap: the accumulation order
-    changes, the bits do not (int32 adds wrap)."""
+    (``max_cells``, default ``PALLAS_SQRT_MAX_CELLS``), not the XLA
+    scan's 64 MiB HBM slab.  Explicit/tuned values obey the shared
+    row-chunk rules (divide R, multiple of 4 when chunking —
+    ``sqrtn._resolve_row_chunk``) and are then halved down to the cell
+    cap: the accumulation order changes, the bits do not (int32 adds
+    wrap).  That halving used to be silent — callers that need to know
+    whether the kernel they dispatch matches the chunk their cache
+    entry claims compare this function's answer against the request
+    (``api``'s ``row_chunk_effective`` provenance)."""
     from ..core.sqrtn import ROW_CHUNK_FLOOR, _resolve_row_chunk
+    cap = PALLAS_SQRT_MAX_CELLS if max_cells is None else int(max_cells)
     rc = r if row_chunk is None else _resolve_row_chunk(r, k, 1, row_chunk)
     # halving preserves "divides R"; the %8 guard keeps rc a multiple
     # of 4 all the way down to the 4-row interleave floor
-    while rc * k > PALLAS_SQRT_MAX_CELLS and rc > ROW_CHUNK_FLOOR \
-            and rc % 8 == 0:
+    while rc * k > cap and rc > ROW_CHUNK_FLOOR and rc % 8 == 0:
         rc //= 2
     return rc
 
 
-def _make_sqrt_kernel(prf_method: int, tb: int, rc: int, k: int):
-    """Kernel body for one (key tile, row tile) grid step."""
+def _make_sqrt_kernel(prf_method: int, tb: int, rc: int, k: int,
+                      j_axis: int = 1, limbs: str = "low",
+                      cw_add: str = "fused"):
+    """Kernel body for one (key tile, row tile) grid step.
+
+    ``j_axis``: which grid axis is the row-tile (accumulation) axis.
+    ``limbs``/``cw_add``: emission and codeword-select structure (see
+    the module docstring); every combination is bit-identical.
+    """
     from jax.experimental import pallas as pl
 
     blk = _BLK_CORES.get(prf_method)
     core = None if blk is not None else _CORES[prf_method]
     cells = rc * k
+    nlimb = 4 if limbs == "multi" else 1
+
+    def tile(p):
+        """[TB, rc, K]-broadcast -> [TB, cells] cell plane."""
+        return jnp.broadcast_to(p, (tb, rc, k)).reshape(tb, cells)
 
     def kernel(row0_ref, seeds_ref, cw1_ref, cw2_ref, table_ref, out_ref):
-        j = pl.program_id(1)
+        j = pl.program_id(j_axis)
         row0 = row0_ref[0, 0]                          # this tile's base row
         s = [seeds_ref[i] for i in range(4)]           # [TB, K]
         # cell m = t*K + c: grid row row0+t under column seed c —
@@ -110,26 +145,39 @@ def _make_sqrt_kernel(prf_method: int, tb: int, rc: int, k: int):
                    + lax.broadcasted_iota(jnp.uint32, (tb, nctr, k), 1)
                    .reshape(tb, nctr * k))
             out16 = blk(planes, ctr)
-            # row 4c+g = block words [4g..4g+3] MSW-first, so the low
-            # limb is word 4g+3 (``_grid_vals``/``_blk_group``)
-            val0 = jnp.stack([out16[4 * g + 3].reshape(tb, nctr, k)
-                              for g in range(4)],
-                             axis=2).reshape(tb, cells)
+            # row 4c+g = block words [4g..4g+3] MSW-first, so limb l of
+            # that row is word 4g+3-l (``_grid_vals``/``_blk_group``)
+            vals = [jnp.stack([out16[4 * g + 3 - l].reshape(tb, nctr, k)
+                               for g in range(4)],
+                              axis=2).reshape(tb, cells)
+                    for l in range(nlimb)]
         else:
-            planes = [jnp.broadcast_to(p[:, None, :], (tb, rc, k))
-                      .reshape(tb, cells) for p in s]
+            planes = [tile(p[:, None, :]) for p in s]
             pos = (row0 + lax.broadcasted_iota(jnp.uint32, (tb, rc, k), 1)
                    .reshape(tb, cells))
-            val0 = core(planes, pos)[0]
-        sel = (s[0] & np.uint32(1)).astype(jnp.bool_)  # [TB, K]
-        cw_lo = jnp.where(
-            jnp.broadcast_to(sel[:, None, :], (tb, rc, k))
-            .reshape(tb, cells),
-            jnp.broadcast_to(cw2_ref[:][:, :, None], (tb, rc, k))
-            .reshape(tb, cells),
-            jnp.broadcast_to(cw1_ref[:][:, :, None], (tb, rc, k))
-            .reshape(tb, cells))
-        leaves = (val0 + cw_lo).astype(jnp.int32)      # [TB, cells]
+            vals = list(core(planes, pos)[:nlimb])
+        sel = (s[0] & np.uint32(1))                    # [TB, K] u32 0/1
+
+        def select(c1, c2):
+            """The codeword the LSB picks, as a [TB, cells] plane."""
+            if cw_add == "staged":
+                # base + masked correction: cw1 + sel*(cw2-cw1), exact
+                # mod 2^32 (u32 wraps) — two staged adds, no select op
+                return tile(c1[:, :, None]) + tile(sel[:, None, :]) * \
+                    tile((c2 - c1)[:, :, None])
+            return jnp.where(tile(sel.astype(jnp.bool_)[:, None, :]),
+                             tile(c2[:, :, None]), tile(c1[:, :, None]))
+
+        if limbs == "multi":
+            # the scan path's exact arithmetic: all four value limbs +
+            # the full 128-bit carry chain, low limb contracted (carries
+            # only propagate upward, so the bits match the low-only path)
+            cw = [select(cw1_ref[..., l], cw2_ref[..., l])
+                  for l in range(4)]
+            leaves = _add128_planes(vals, cw)[0].astype(jnp.int32)
+        else:
+            leaves = (vals[0] + select(cw1_ref[:], cw2_ref[:])) \
+                .astype(jnp.int32)                     # [TB, cells]
         contrib = lax.dot_general(
             leaves, table_ref[:], (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.int32)          # x [E, cells]
@@ -147,13 +195,18 @@ def _make_sqrt_kernel(prf_method: int, tb: int, rc: int, k: int):
 
 def _sqrt_grid_contract_impl(seeds, cw1, cw2, table, row0, *,
                              prf_method: int, row_chunk: int | None = None,
-                             interpret=False, tb: int | None = None):
+                             interpret=False, tb: int | None = None,
+                             max_cells: int | None = None,
+                             grid_order: str = "bk",
+                             dim_semantics: str = "parallel",
+                             limbs: str = "low", cw_add: str = "fused"):
     """Traceable launcher (the sharded per-shard body calls this inside
     its own jit/shard_map with a TRACED ``row0``).
 
     seeds: [B, K, 4] u32; cw1/cw2: [B, R, 4] u32; table: [R*K, E] int32
     natural-order rows for grid rows row0..row0+R-1.  Returns [B, E]
-    int32 shares, bit-identical to the scan oracle.
+    int32 shares, bit-identical to the scan oracle for EVERY variant of
+    (tb, max_cells, grid_order, dim_semantics, limbs, cw_add).
     """
     from jax.experimental import pallas as pl
 
@@ -164,7 +217,19 @@ def _sqrt_grid_contract_impl(seeds, cw1, cw2, table, row0, *,
     reason = pallas_sqrt_unsupported(prf_method, r)
     if reason:
         raise ValueError(reason)
-    rc = pallas_sqrt_row_chunk(r, k, row_chunk)
+    if grid_order not in ("bk", "kb"):
+        raise ValueError("grid_order must be 'bk' or 'kb' (got %r)"
+                         % (grid_order,))
+    if dim_semantics not in ("parallel", "arbitrary"):
+        raise ValueError("dim_semantics must be 'parallel' or "
+                         "'arbitrary' (got %r)" % (dim_semantics,))
+    if limbs not in ("low", "multi"):
+        raise ValueError("limbs must be 'low' or 'multi' (got %r)"
+                         % (limbs,))
+    if cw_add not in ("fused", "staged"):
+        raise ValueError("cw_add must be 'fused' or 'staged' (got %r)"
+                         % (cw_add,))
+    rc = pallas_sqrt_row_chunk(r, k, row_chunk, max_cells)
     steps = r // rc
 
     tb = tb or min(PALLAS_SQRT_TB, max(8, bsz))
@@ -174,55 +239,98 @@ def _sqrt_grid_contract_impl(seeds, cw1, cw2, table, row0, *,
         cw1 = jnp.pad(cw1, ((0, pb), (0, 0), (0, 0)))
         cw2 = jnp.pad(cw2, ((0, pb), (0, 0), (0, 0)))
     bp = bsz + pb
+    if grid_order == "kb" and bp > tb:
+        # rows-outer revisits each output block from NON-adjacent grid
+        # steps once there is more than one key tile — not Mosaic-legal
+        # (the searcher's validity predicate mirrors this rule)
+        raise ValueError(
+            "grid_order='kb' needs the batch (%d padded) to fit one "
+            "key tile (tb=%d): rows-outer iteration would revisit "
+            "output blocks non-consecutively" % (bp, tb))
 
     sm = jnp.transpose(seeds, (2, 0, 1))               # [4, B, K]
-    cw1_lo = cw1[:, :, 0]                              # [B, R] low limbs
-    cw2_lo = cw2[:, :, 0]
+    if limbs == "multi":
+        cw1_in, cw2_in = cw1, cw2                      # [B, R, 4] full
+        cw_spec = lambda im: pl.BlockSpec((tb, rc, 4), im)  # noqa: E731
+        cw_maps = (lambda i, j: (i, j, 0)), (lambda j, i: (i, j, 0))
+    else:
+        cw1_in, cw2_in = cw1[:, :, 0], cw2[:, :, 0]    # [B, R] low limbs
+        cw_spec = lambda im: pl.BlockSpec((tb, rc), im)  # noqa: E731
+        cw_maps = (lambda i, j: (i, j)), (lambda j, i: (i, j))
     table_t = table.T                                  # [E, R*K]
     row0s = (jnp.asarray(row0, jnp.uint32)
              + jnp.arange(steps, dtype=jnp.uint32)
              * jnp.uint32(rc))[:, None]                # [steps, 1]
 
-    grid = (bp // tb, steps)
-    kernel = _make_sqrt_kernel(prf_method, tb, rc, k)
+    if grid_order == "bk":
+        grid = (bp // tb, steps)
+        j_axis, cw_map = 1, cw_maps[0]
+        maps = (lambda i, j: (j, 0),          # row0s
+                lambda i, j: (0, i, 0),       # seeds
+                lambda i, j: (0, j),          # table
+                lambda i, j: (i, 0))          # out
+        semantics = (dim_semantics, "arbitrary")
+    else:
+        grid = (steps, bp // tb)
+        j_axis, cw_map = 0, cw_maps[1]
+        maps = (lambda j, i: (j, 0),
+                lambda j, i: (0, i, 0),
+                lambda j, i: (0, j),
+                lambda j, i: (i, 0))
+        semantics = ("arbitrary", dim_semantics)
+
+    kernel = _make_sqrt_kernel(prf_method, tb, rc, k, j_axis=j_axis,
+                               limbs=limbs, cw_add=cw_add)
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1), lambda i, j: (j, 0)),
-            pl.BlockSpec((4, tb, k), lambda i, j: (0, i, 0)),
-            pl.BlockSpec((tb, rc), lambda i, j: (i, j)),
-            pl.BlockSpec((tb, rc), lambda i, j: (i, j)),
-            pl.BlockSpec((e, rc * k), lambda i, j: (0, j)),
+            pl.BlockSpec((1, 1), maps[0]),
+            pl.BlockSpec((4, tb, k), maps[1]),
+            cw_spec(cw_map),
+            cw_spec(cw_map),
+            pl.BlockSpec((e, rc * k), maps[2]),
         ],
-        out_specs=pl.BlockSpec((tb, e), lambda i, j: (i, 0)),
+        out_specs=pl.BlockSpec((tb, e), maps[3]),
         out_shape=jax.ShapeDtypeStruct((bp, e), jnp.int32),
         interpret=interpret,
         # key tiles are independent; the row-tile axis accumulates into
         # the same [tb, E] output block (reduction dim -> "arbitrary")
-        compiler_params=_compiler_params(("parallel", "arbitrary")),
-    )(row0s, sm, cw1_lo, cw2_lo, table_t)
+        compiler_params=_compiler_params(semantics),
+    )(row0s, sm, cw1_in, cw2_in, table_t)
     return out[:bsz]
 
 
+_VARIANT_FIELDS = ("tb", "max_cells", "grid_order", "dim_semantics",
+                   "limbs", "cw_add")
+
 _sqrt_grid_contract_jit = functools.partial(
-    jax.jit, static_argnames=("prf_method", "row_chunk", "interpret",
-                              "tb"))(_sqrt_grid_contract_impl)
+    jax.jit, static_argnames=("prf_method", "row_chunk", "interpret")
+    + _VARIANT_FIELDS)(_sqrt_grid_contract_impl)
 
 
 def sqrt_grid_contract_pallas(seeds, cw1, cw2, table, *, prf_method: int,
                               row_chunk: int | None = None, row0=0,
-                              interpret=False, tb: int | None = None):
+                              interpret=False, tb: int | None = None,
+                              max_cells: int | None = None,
+                              grid_order: str = "bk",
+                              dim_semantics: str = "parallel",
+                              limbs: str = "low", cw_add: str = "fused"):
     """Jit-wrapped fused sqrt-N grid kernel; ``interpret=True`` runs
     EAGERLY (see ``pallas_level.chacha_level_step_pallas`` —
     interpret-under-jit compile blows up super-linearly on XLA-CPU).
 
     ``row0`` may be a traced uint32 scalar (the sharded path's
     per-shard row base); already-traced callers get the impl inlined.
+    The variant keywords default to the PR-10 hand-tuned structure; the
+    kernel search (``tune/kernel_search.py``) threads searched values
+    through here.
     """
     args = (jnp.asarray(seeds), jnp.asarray(cw1), jnp.asarray(cw2),
             jnp.asarray(table), row0)
     fn = (_sqrt_grid_contract_impl if interpret
           else _sqrt_grid_contract_jit)
     return fn(*args, prf_method=prf_method, row_chunk=row_chunk,
-              interpret=interpret, tb=tb)
+              interpret=interpret, tb=tb, max_cells=max_cells,
+              grid_order=grid_order, dim_semantics=dim_semantics,
+              limbs=limbs, cw_add=cw_add)

@@ -743,3 +743,141 @@ class ClusterRouter:
                 node.close()
             except Exception as e:
                 note_swallowed("cluster.close", e, self.recovery)
+
+
+# ------------------------------------------------- batch-PIR group routing
+
+class ClusterPIRRouter:
+    """Bin-sharded batch-PIR over cluster hosts with per-size-group
+    routing (the PR-11 remainder).
+
+    Full-domain DPF batches cannot skip granules — an additive share is
+    pseudorandom over EVERY row, so every covering host must see every
+    batch (``ClusterRouter``'s scatter).  Batch-PIR is different: each
+    BIN is an independent padded mini-table with its own keys, so the
+    whole bin is the natural routing unit.  Bins are laid out in
+    descending padded-size order (a stable layout both sides can derive)
+    and partitioned contiguously over hosts balanced by padded rows —
+    each host's slice of that virtual row space is its granule, and,
+    because equal-size bins are contiguous in the layout, each (n, G)
+    size group lands on a contiguous few hosts rather than all of them.
+
+    ``routed=True`` (the new path) dispatches each size group's keys
+    ONLY to the hosts whose bins cover it; ``routed=False`` replays the
+    pre-PR behaviour — every size group is delivered to every host and
+    the host drops the foreign bins.  Both produce bit-identical
+    per-bin answers (each bin has exactly one owner; the parity test
+    gates routed vs broadcast vs the single-server oracle) — the
+    difference is ``dispatch_counts``: per-host size-group deliveries,
+    which the ``--multihost`` bench asserts shrink under routing.
+
+    Hosts run ordinary :class:`~dpf_tpu.apps.batch_pir.
+    PrivateLookupServer` instances over their owned bins, so the
+    per-group construction resolution, packed wire-codec ingest and
+    async all-groups dispatch are exactly the single-host production
+    path.  ``scheme="auto"`` is rejected: its per-group construction
+    choice consults the tuning cache keyed by GROUP size, which differs
+    between a host's slice and the client's global view — the client
+    and every host must derive identical constructions from the
+    arguments alone.
+    """
+
+    def __init__(self, table, bins, hosts: int = 2, *, prf=None,
+                 radix: int = 2, scheme: str = "logn",
+                 routed: bool = True):
+        from ..apps.batch_pir import PrivateLookupServer, _pad_pow2
+        if scheme == "auto":
+            raise ValueError(
+                "ClusterPIRRouter needs a concrete scheme: 'auto' "
+                "resolves per-group constructions from the tuning "
+                "cache keyed by group size, which differs between a "
+                "host's bin slice and the client's global view")
+        if hosts < 1:
+            raise ValueError("hosts must be >= 1 (got %d)" % hosts)
+        self.routed = bool(routed)
+        self.bins = [sorted(b) for b in bins]
+        padded = [_pad_pow2(max(1, len(b))) for b in self.bins]
+        # stable descending-size layout: equal-size bins contiguous
+        order = sorted(range(len(self.bins)),
+                       key=lambda i: (-padded[i], i))
+        # contiguous partition balanced by padded rows
+        total = sum(padded)
+        target = total / hosts
+        shards: list[list[int]] = [[] for _ in range(hosts)]
+        h = acc = 0
+        for bi in order:
+            if (h < hosts - 1 and acc >= target * (h + 1)
+                    and shards[h]):
+                h += 1
+            shards[h].append(bi)
+            acc += padded[bi]
+        self._hosts = []           # [(label, server, global bin idxs)]
+        for i, idxs in enumerate(shards):
+            lb = "pirhost%d" % i
+            srv = (PrivateLookupServer(
+                       np.asarray(table),
+                       [self.bins[bi] for bi in idxs], prf=prf,
+                       radix=radix, scheme=scheme)
+                   if idxs else None)
+            self._hosts.append((lb, srv, tuple(idxs)))
+        self.group_sizes = tuple(sorted(set(padded), reverse=True))
+        self._padded = padded
+        #: {size: [labels owning >= 1 bin of that size group]}
+        self.owners = {
+            n: [lb for lb, _, idxs in self._hosts
+                if any(padded[bi] == n for bi in idxs)]
+            for n in self.group_sizes}
+        self.dispatch_counts = {lb: 0 for lb, _, _ in self._hosts}
+        self.entry_size = int(np.asarray(table).shape[1])
+
+    def host_groups(self, label: str) -> tuple:
+        """Padded sizes of the size groups ``label``'s bins cover."""
+        for lb, _, idxs in self._hosts:
+            if lb == label:
+                return tuple(sorted({self._padded[bi] for bi in idxs},
+                                    reverse=True))
+        raise KeyError(label)
+
+    def answer(self, keys_per_bin) -> np.ndarray:
+        """Per-bin answer shares ``[n_bins, E]`` for one query round
+        (same contract as ``PrivateLookupServer.answer``; the client
+        side is unchanged).  Routed mode delivers each size group only
+        to its owner hosts; broadcast mode delivers every group to
+        every host (which drops foreign bins) — ``dispatch_counts``
+        records the per-host deliveries either way."""
+        if len(keys_per_bin) != len(self.bins):
+            raise ValueError("expected one key per bin (%d), got %d"
+                             % (len(self.bins), len(keys_per_bin)))
+        out = np.zeros((len(self.bins), self.entry_size),
+                       dtype=np.int32)
+        total = 0
+        for lb, srv, idxs in self._hosts:
+            if self.routed:
+                if not idxs:
+                    continue  # no bins -> no group routed here
+                delivered = len({self._padded[bi] for bi in idxs})
+            else:
+                delivered = len(self.group_sizes)
+            self.dispatch_counts[lb] += delivered
+            total += delivered
+            if srv is None or not idxs:
+                continue
+            ans = np.asarray(srv.answer([keys_per_bin[bi]
+                                         for bi in idxs]))
+            out[list(idxs)] = ans
+        FLIGHT.record(
+            "pir_scatter", routed=self.routed, dispatches=total,
+            hosts={lb: len(idxs) for lb, _, idxs in self._hosts},
+            groups=len(self.group_sizes))
+        return out
+
+    def stats(self) -> dict:
+        return {
+            "routed": self.routed,
+            "group_sizes": list(self.group_sizes),
+            "owners": {int(n): list(lbs)
+                       for n, lbs in self.owners.items()},
+            "bins_per_host": {lb: len(idxs)
+                              for lb, _, idxs in self._hosts},
+            "dispatch_counts": dict(self.dispatch_counts),
+        }

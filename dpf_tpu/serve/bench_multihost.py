@@ -14,6 +14,12 @@ bursty trace three times:
   host's granules are redistributed over the survivors (device_put
   only — the traced-row0 program never recompiles).
 
+A fourth section, ``pir_group_routing``, gates the batch-PIR
+size-group routing tier (``parallel/cluster.ClusterPIRRouter``):
+routed dispatch (each size group only to the hosts whose bins cover
+it) must bit-match both the broadcast replay and the single-server
+oracle while strictly reducing per-host size-group deliveries.
+
 Two execution modes run the IDENTICAL router/recovery state machine:
 
 * ``multiprocess`` (default) — one OS process per host
@@ -169,6 +175,73 @@ class _ClusterClient:
             self.failed_batches += 1
             return _FailedBatch()
         return _VerifiedFuture(self, a, j, fut)
+
+
+def _pir_routing_leg(*, prf, hosts, seed, dryrun=False) -> dict:
+    """Batch-PIR size-group routing leg (PR-11 remainder): a
+    bin-sharded ``ClusterPIRRouter`` answers one query round twice —
+    ``routed`` (each size group dispatched only to its owner hosts)
+    and ``broadcast`` (every group to every host, the pre-routing
+    behaviour) — and both are bit-gated against the single-server
+    oracle AND against each other; the record proves routing strictly
+    reduces per-host size-group deliveries without changing a bit of
+    the merged answers."""
+    from ..apps.batch_pir import PrivateLookupClient, PrivateLookupServer
+    from ..parallel.cluster import ClusterPIRRouter
+
+    rng = np.random.default_rng(seed ^ 0x91A)
+    if dryrun:
+        n_pir, e = 1024, 4
+        sizes = (150, 130, 60, 50, 20, 10)
+    else:
+        n_pir, e = 4096, 8
+        sizes = (700, 650, 300, 260, 130, 120, 60, 50)
+    table = rng.integers(0, 2**31, size=(n_pir, e), dtype=np.int32)
+    universe = rng.permutation(n_pir)
+    bins, off = [], 0
+    for sz in sizes:
+        bins.append(universe[off:off + sz].tolist())
+        off += sz
+    pir_hosts = max(2, min(hosts, 4))
+
+    oracle_a = PrivateLookupServer(table, bins, prf=prf, scheme="logn")
+    oracle_b = PrivateLookupServer(table, bins, prf=prf, scheme="logn")
+    client = PrivateLookupClient(bins, oracle_a.bin_sizes, prf=prf,
+                                 scheme="logn")
+    wanted = [b[len(b) // 2] for b in bins]
+    ka, kb, plan = client.make_queries(wanted)
+
+    routed = ClusterPIRRouter(table, bins, hosts=pir_hosts, prf=prf,
+                              scheme="logn", routed=True)
+    bcast = ClusterPIRRouter(table, bins, hosts=pir_hosts, prf=prf,
+                             scheme="logn", routed=False)
+    ans_oracle = np.asarray(oracle_a.answer(ka))
+    ans_routed = routed.answer(ka)
+    ans_bcast = bcast.answer(ka)
+    parity = bool(np.array_equal(ans_routed, ans_oracle)
+                  and np.array_equal(ans_bcast, ans_oracle))
+    rec = client.recover(ans_routed, np.asarray(oracle_b.answer(kb)),
+                         plan)
+    recover_ok = all(np.array_equal(rec[t], table[t]) for t in wanted)
+    r_total = sum(routed.dispatch_counts.values())
+    b_total = sum(bcast.dispatch_counts.values())
+    return {
+        "hosts": pir_hosts,
+        "bins": len(bins),
+        "bin_sizes": list(sizes),
+        "group_sizes": list(routed.group_sizes),
+        "owners": {int(n): lbs for n, lbs in routed.owners.items()},
+        "bins_per_host": routed.stats()["bins_per_host"],
+        "routed_dispatches": r_total,
+        "broadcast_dispatches": b_total,
+        "dispatch_counts_routed": dict(routed.dispatch_counts),
+        "dispatch_counts_broadcast": dict(bcast.dispatch_counts),
+        "dispatch_reduction": (round(1 - r_total / b_total, 4)
+                               if b_total else None),
+        "parity_vs_oracle": parity,
+        "recover_ok": recover_ok,
+        "checked": bool(parity and recover_ok and r_total < b_total),
+    }
 
 
 def _build_cluster(mode, table, hosts, *, oracle, buckets, policy,
@@ -357,6 +430,8 @@ def multihost_bench(n=4096, entry_size=16, cap=128, prf=0, *,
     reshard_leg = _run_leg(mode, table, hosts, trace, pool, oracle,
                            policy="reshard", victim=victim,
                            kill_at=kill_at, **leg_kw)
+    pir_leg = _pir_routing_leg(prf=prf, hosts=hosts, seed=seed,
+                               dryrun=n <= 1024)
 
     chaos_avail = [leg["availability"]
                    for leg in (degrade_leg, reshard_leg)]
@@ -396,6 +471,7 @@ def multihost_bench(n=4096, entry_size=16, cap=128, prf=0, *,
         "baseline_leg": baseline,
         "chaos_degrade_leg": degrade_leg,
         "chaos_reshard_leg": reshard_leg,
+        "pir_group_routing": pir_leg,
         "swallowed_errors": swallowed_snapshot(),
         "gate_escapes": total_escapes,
         "checked": bool(
@@ -404,7 +480,8 @@ def multihost_bench(n=4096, entry_size=16, cap=128, prf=0, *,
             and degrade_leg["drop_attributed"]
             and reshard_leg["drop_attributed"]
             and degrade_leg["decision_counts"]["degrade"] >= 1
-            and reshard_leg["decision_counts"]["reshard"] >= 1),
+            and reshard_leg["decision_counts"]["reshard"] >= 1
+            and pir_leg["checked"]),
     }
     record["obs"] = record_sections()
     if not record["checked"]:

@@ -334,8 +334,12 @@ class ServingEngine:
         Because each dispatch goes through ``resolved_eval_knobs``, the
         precompiled program is whatever kernel the resolver picks —
         for sqrtn that includes ``kernel_impl`` ("xla" scan or the
-        fused "pallas" grid kernel), so real traffic hits a warm cache
-        for the same kernel it will actually run.
+        fused "pallas" grid kernel) AND any searched kernel variant
+        (a ``kvariant`` tuning-cache entry from ``tune/
+        kernel_search.py`` resolves with ``kernel_resolved_from=
+        "searched"`` and its structural keywords thread through to the
+        launcher), so real traffic hits a warm cache for the same
+        program the search picked.
 
         ``tune=True`` first re-tunes the serving knobs in place: the
         persistent tuning cache (``tune/cache.py``) is consulted for

@@ -394,24 +394,40 @@ class SchemeRouter:
     def _note_success(self, lb: str) -> None:
         self.breakers[lb].record_success()
 
-    def dispatch_kernel(self, lb: str, bucket: int) -> str | None:
-        """The per-dispatch ``kernel_impl`` the construction's server
-        would resolve at this bucket (None when the server doesn't
-        expose its resolution — or for the logn schemes before their
-        resolver learns the field).  Recorded on route events and as a
-        label on the EWMA cost-table metrics series so a relay-TPU
-        ``--load`` run can attribute latency shifts to kernel
-        selection.  Cheap: ``resolved_eval_knobs`` memoizes its tuning
-        lookup per batch size."""
+    def dispatch_kernel_info(self, lb: str, bucket: int) -> dict:
+        """The per-dispatch kernel decision the construction's server
+        would resolve at this bucket: ``kernel_impl`` plus — when the
+        resolver reports them — ``kernel_resolved_from`` provenance
+        ("searched" for a tune/kernel_search variant) and
+        ``row_chunk_effective`` (the chunk the Pallas grid kernel will
+        actually run after its VMEM cell cap; surfacing it on route
+        events is what keeps a halved chunk from being an invisible
+        different kernel than the cache entry claims).  Empty dict when
+        the server doesn't expose a resolution.  Cheap:
+        ``resolved_eval_knobs`` memoizes its tuning lookup per batch
+        size."""
         try:
             eng = self.engines.get(lb)
             rk = getattr(getattr(eng, "_server", None),
                          "resolved_eval_knobs", None)
             if callable(rk):
-                return rk(bucket).get("kernel_impl")
+                kn = rk(bucket)
+                info = {"kernel_impl": kn.get("kernel_impl")}
+                for extra in ("kernel_resolved_from",
+                              "row_chunk_effective"):
+                    if kn.get(extra) is not None:
+                        info[extra] = kn[extra]
+                return info
         except Exception as e:  # diagnostics must never break routing
             note_swallowed("serve.router.dispatch_kernel", e)
-        return None
+        return {}
+
+    def dispatch_kernel(self, lb: str, bucket: int) -> str | None:
+        """The bare ``kernel_impl`` of :meth:`dispatch_kernel_info`
+        (kept as the EWMA cost-table metrics label so a relay-TPU
+        ``--load`` run can attribute latency shifts to kernel
+        selection)."""
+        return self.dispatch_kernel_info(lb, bucket).get("kernel_impl")
 
     def route(self, batch: int, exclude=()) -> RouteDecision:
         """Pick the construction for a ``batch``-query arrival.
@@ -472,14 +488,19 @@ class SchemeRouter:
             self.route_counts[label] += 1
             self.routed_from_counts[routed_from] = (
                 self.routed_from_counts.get(routed_from, 0) + 1)
+            # the winning construction's per-dispatch kernel decision
+            # (impl + searched/halved provenance) — fault/latency
+            # attribution joins on it
+            kinfo = self.dispatch_kernel_info(label, bucket)
             ev = {"construction": label, "routed_from": routed_from,
                   "bucket": bucket, "batch": batch,
-                  # the winning construction's per-dispatch kernel
-                  # decision — fault/latency attribution joins on it
-                  "kernel_impl": self.dispatch_kernel(label, bucket),
+                  "kernel_impl": kinfo.get("kernel_impl"),
                   "costs_ms": {lb: (None if c is None
                                     else round(c * 1e3, 4))
                                for lb, c in costs.items()}}
+            for extra in ("kernel_resolved_from", "row_chunk_effective"):
+                if kinfo.get(extra) is not None:
+                    ev[extra] = kinfo[extra]
             if self.injector is not None:
                 # the arrival index FaultInjector events carry too —
                 # the join key for fault -> route attribution

@@ -11,7 +11,13 @@ oracle, and the winners persisted in a JSON cache keyed by device
 fingerprint x shape (``cache``/``fingerprint``).  ``search.scheme_sweep``
 goes one level up and races the three constructions (logn, radix-4,
 sqrtn) per shape, so the cache can also answer "which construction"
-(``cache.lookup_scheme``).  ``mesh_tune`` extends the space to the
+(``cache.lookup_scheme``).  ``kernel_search`` goes one level DOWN and
+searches the sqrt-N kernel space itself — serializable
+``KernelVariant`` structures (tile shape, VMEM budget, grid order,
+limb emission, codeword-select form) evolved by seeded
+mutate/tournament, equality/parity-gated, persisted as ``kvariant``
+entries (``cache.lookup_kernel_variant``) that
+``api.resolved_eval_knobs`` consumes with provenance ``"searched"``.  ``mesh_tune`` extends the space to the
 mesh path — per-shard chunking, psum granularity, the mesh-shape split,
 and the engine ladder on the mesh batch axis — keyed by device
 fingerprint x mesh split (``benchmark.py --multichip`` drives it; see
@@ -21,10 +27,13 @@ processes.  See docs/TUNING.md.
 """
 
 from .cache import (  # noqa: F401
-    TuningCache, default_cache, lookup_eval_knobs, lookup_mesh_knobs,
-    lookup_scheme)
+    TuningCache, default_cache, lookup_eval_knobs, lookup_kernel_variant,
+    lookup_mesh_knobs, lookup_scheme)
 from .compcache import enable as enable_compilation_cache  # noqa: F401
 from .fingerprint import cache_key, device_fingerprint, mesh_tag  # noqa: F401
+from .kernel_search import (  # noqa: F401
+    KernelVariant, kernel_search, kernel_search_sweep, mutate_variant,
+    pallas_parity_ok, sample_variant, variant_invalid)
 from .mesh_tune import (  # noqa: F401
     lookup_mesh_split, mesh_split_candidates, tune_mesh_eval,
     tune_mesh_serving, tune_mesh_shape)

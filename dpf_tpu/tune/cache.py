@@ -213,6 +213,24 @@ def lookup_mesh_knobs(*, n: int, entry_size: int, batch: int,
         return None
 
 
+def lookup_kernel_variant(*, n: int, entry_size: int, batch: int,
+                          prf_method: int) -> dict | None:
+    """The searched kernel-variant knobs for this sqrtn shape on this
+    machine (``{"kernel_impl": ..., "row_chunk": ..., "dot_impl": ...,
+    "kernel_variant": {...}}``), recorded by ``benchmark.py
+    --autotune-kernel`` (``tune.kernel_search``) under the ``kvariant``
+    entry kind — a NEW kind, so pre-variant ``tuning.json`` files have
+    no such entries and this lookup is simply a miss on them.
+    Nearest-batch fallback like the eval-knob lookup.  Never raises."""
+    try:
+        return default_cache().lookup_knobs(
+            "kvariant", nearest_batch=True, n=n, entry_size=entry_size,
+            batch=batch, prf_method=prf_method, scheme="sqrtn", radix=2)
+    except Exception as e:  # pragma: no cover — never break serving
+        note_swallowed("tune.cache.lookup_kernel_variant", e)
+        return None
+
+
 def lookup_scheme(*, n: int, entry_size: int, batch: int,
                   prf_method: int) -> dict | None:
     """The measured winning construction for this shape on this machine

@@ -1,0 +1,591 @@
+"""Generative kernel-variant search over the sqrt-N PRF->contract space.
+
+``tune/search.py`` does staged coordinate descent over a hand-enumerated
+scalar knob grid.  This module searches the KERNEL space itself — the
+structural choices PR 10 hard-coded by hand: the Pallas grid kernel's
+tile shape / VMEM cell budget / grid iteration order / dimension
+semantics / limb emission / codeword-select structure, and the XLA
+scan's (row_chunk, dot_impl) pairing.  Each point in that space is a
+serializable :class:`KernelVariant`; the search is seeded
+mutate/tournament (the AlphaEvolve-for-FHE generate-then-verify move,
+PAPERS.md arXiv:2605.14718, and the NTT codegen loop arXiv:2502.11110)
+over a population that always contains the staged-descent winner and
+the static heuristics, so it can never regress either.
+
+**Trust model** — zero new correctness machinery:
+
+- every TIMED candidate first passes the scalar-oracle equality gate
+  (full [B, E] shares bit-identical to ``DPF.eval_cpu``), exactly the
+  ``tune_eval`` contract; a mutation that produces an invalid variant
+  is rejected by :func:`variant_invalid` BEFORE it is ever built, so a
+  clean search reports ``rejected == 0`` and ``gate_escapes == 0``;
+- every PALLAS variant additionally proves interpret-mode parity
+  against the scan oracle on a small grid (eager, CPU-safe), which is
+  what makes the search meaningful off-TPU: the XLA family races on
+  wall-clock, the Pallas family is parity-gated and PINNED in the
+  record for the relay TPU session to race natively.
+
+Winners persist in the tuning cache as a new ``kvariant|...`` entry
+kind (fingerprint x shape keyed; the old entry grammar is untouched),
+consumed by ``api.resolved_eval_knobs`` with provenance
+``kernel_resolved_from="searched"``.  ``benchmark.py --autotune-kernel``
+drives :func:`kernel_search_sweep` and commits the record as
+``BENCH_KSEARCH_r15.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import time
+
+import numpy as np
+
+from ..core.prf_ref import PRF_CHACHA20, PRF_NAMES
+from ..ops import matmul128
+from ..utils.config import EvalConfig
+from ..utils.profiling import CACHE_COUNTERS
+from . import compcache
+from .cache import TuningCache, default_cache
+from .fingerprint import cache_key, device_fingerprint
+from .search import _workload, heuristic_knobs, tune_eval
+
+#: tuning-cache entry kind for searched kernel variants
+VARIANT_KIND = "kvariant"
+
+#: sampled Pallas tile heights (multiples of 8 — the f32/i32 sublane)
+_TB_CHOICES = (8, 16, 32, 64, 128)
+#: sampled VMEM cell budgets around the PR-10 hand-tuned 2048
+_MAX_CELLS_CHOICES = (512, 1024, 2048, 4096, 8192)
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelVariant:
+    """One point in the kernel space, serializable into the tuning
+    cache.  ``family`` picks the program: ``"xla"`` (the chunked scan —
+    searched fields ``row_chunk``/``dot_impl``) or ``"pallas"`` (the
+    fused grid kernel — searched fields ``tb``/``max_cells``/
+    ``grid_order``/``dim_semantics``/``limbs``/``cw_add``, the
+    ``ops.pallas_sqrt`` launcher keywords).  ``None`` fields mean "the
+    launcher's default"; every variant is bit-identical to the scan
+    oracle by construction, so a variant only ever changes the
+    schedule, never the answer."""
+    family: str = "xla"
+    row_chunk: int | None = None
+    dot_impl: str | None = None
+    tb: int | None = None
+    max_cells: int | None = None
+    grid_order: str | None = None
+    dim_semantics: str | None = None
+    limbs: str | None = None
+    cw_add: str | None = None
+
+    def to_dict(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "KernelVariant":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in dict(d).items() if k in known})
+
+    def launcher_kwargs(self) -> dict:
+        """The ``sqrt_grid_contract_pallas`` structural keywords."""
+        from ..ops.pallas_sqrt import _VARIANT_FIELDS
+        return {k: v for k, v in self.to_dict().items()
+                if k in _VARIANT_FIELDS}
+
+    def eval_knobs(self) -> dict:
+        """This variant as a resolved sqrtn knob dict (what the
+        ``_searched`` slot of the tuned-cache memo carries into
+        ``api.resolved_eval_knobs``)."""
+        return {
+            "kernel_impl": "pallas" if self.family == "pallas" else "xla",
+            "row_chunk": self.row_chunk,
+            "dot_impl": self.dot_impl,
+            "kernel_variant": self.to_dict(),
+        }
+
+    def tag(self) -> str:
+        if self.family == "pallas":
+            return "p.tb%s.mc%s.%s.%s.%s.%s" % (
+                self.tb, self.max_cells, self.grid_order or "bk",
+                (self.dim_semantics or "parallel")[:3],
+                self.limbs or "low", self.cw_add or "fused")
+        return "x.rc%s.%s" % (self.row_chunk, self.dot_impl)
+
+
+#: the PR-10 hand-tuned Pallas structure — the seed of the Pallas
+#: family (and the baseline any searched winner must not regress)
+def pr10_default_variant() -> KernelVariant:
+    from ..ops import pallas_sqrt
+    return KernelVariant(
+        family="pallas", tb=pallas_sqrt.PALLAS_SQRT_TB,
+        max_cells=pallas_sqrt.PALLAS_SQRT_MAX_CELLS, grid_order="bk",
+        dim_semantics="parallel", limbs="low", cw_add="fused")
+
+
+def variant_invalid(v: KernelVariant, *, n: int, batch: int,
+                    prf_method: int) -> str | None:
+    """Why this variant may not even be BUILT for this shape (None =
+    valid).  Mutation consults this before proposing, so an invalid
+    variant never reaches the gate and a clean search rejects nothing."""
+    from ..core import sqrtn
+    k, r = sqrtn.default_split(n)
+    if v.family == "xla":
+        if v.row_chunk is not None:
+            rc = v.row_chunk
+            if rc <= 0 or r % rc or (rc != r and rc % 4):
+                return "row_chunk %r invalid for R=%d" % (rc, r)
+        if v.dot_impl is not None and \
+                v.dot_impl not in matmul128.available_impls():
+            return "dot_impl %r unavailable" % (v.dot_impl,)
+        return None
+    if v.family != "pallas":
+        return "unknown family %r" % (v.family,)
+    from ..ops.pallas_sqrt import pallas_sqrt_unsupported
+    reason = pallas_sqrt_unsupported(prf_method, r)
+    if reason:
+        return reason
+    if v.tb is not None and (v.tb < 8 or v.tb % 8):
+        return "tb %r not a multiple of 8" % (v.tb,)
+    if v.max_cells is not None and v.max_cells < 4 * k:
+        return "max_cells %r below one 4-row interleave (4*K=%d)" \
+            % (v.max_cells, 4 * k)
+    if v.grid_order not in (None, "bk", "kb"):
+        return "grid_order %r" % (v.grid_order,)
+    if v.grid_order == "kb":
+        from ..ops.pallas_sqrt import PALLAS_SQRT_TB
+        tb = v.tb or min(PALLAS_SQRT_TB, max(8, batch))
+        if batch + (-batch) % tb > tb:
+            return ("grid_order='kb' needs one key tile "
+                    "(batch %d > tb %d)" % (batch, tb))
+    if v.dim_semantics not in (None, "parallel", "arbitrary"):
+        return "dim_semantics %r" % (v.dim_semantics,)
+    if v.limbs not in (None, "low", "multi"):
+        return "limbs %r" % (v.limbs,)
+    if v.cw_add not in (None, "fused", "staged"):
+        return "cw_add %r" % (v.cw_add,)
+    return None
+
+
+def _field_choices(v: KernelVariant, field: str, *, n: int,
+                   batch: int) -> list:
+    """Legal values for one variant field at this shape (mutation and
+    sampling draw from these; :func:`variant_invalid` is still the
+    final word on the combination)."""
+    from ..core import sqrtn
+    k, r = sqrtn.default_split(n)
+    if v.family == "xla":
+        return {
+            "row_chunk": sqrtn.sqrt_chunk_candidates(r, k, batch),
+            "dot_impl": list(matmul128.available_impls()),
+        }[field]
+    return {
+        "tb": list(_TB_CHOICES),
+        "max_cells": [c for c in _MAX_CELLS_CHOICES if c >= 4 * k],
+        "grid_order": ["bk", "kb"],
+        "dim_semantics": ["parallel", "arbitrary"],
+        "limbs": ["low", "multi"],
+        "cw_add": ["fused", "staged"],
+    }[field]
+
+
+_XLA_FIELDS = ("row_chunk", "dot_impl")
+_PALLAS_FIELDS = ("tb", "max_cells", "grid_order", "dim_semantics",
+                  "limbs", "cw_add")
+
+
+def mutate_variant(rng: random.Random, v: KernelVariant, *, n: int,
+                   batch: int, prf_method: int,
+                   tries: int = 16) -> KernelVariant | None:
+    """One structural mutation: re-draw a single field from its legal
+    choices, keeping the combination valid.  Deterministic under the
+    caller's seeded ``rng``; None when no valid novel mutation was
+    found in ``tries`` draws (a saturated neighbourhood, not an error)."""
+    fields = _XLA_FIELDS if v.family == "xla" else _PALLAS_FIELDS
+    for _ in range(tries):
+        field = rng.choice(fields)
+        choices = _field_choices(v, field, n=n, batch=batch)
+        choices = [c for c in choices if c != getattr(v, field)]
+        if not choices:
+            continue
+        cand = dataclasses.replace(v, **{field: rng.choice(choices)})
+        if variant_invalid(cand, n=n, batch=batch,
+                           prf_method=prf_method) is None:
+            return cand
+    return None
+
+
+def sample_variant(rng: random.Random, family: str, *, n: int,
+                   batch: int, prf_method: int,
+                   tries: int = 32) -> KernelVariant | None:
+    """One random valid variant of ``family`` (rejection sampling over
+    the per-field choices — the only cross-field constraint is the
+    'kb'-needs-one-key-tile rule, so this converges fast)."""
+    fields = _XLA_FIELDS if family == "xla" else _PALLAS_FIELDS
+    for _ in range(tries):
+        probe = KernelVariant(family=family)
+        draw = {f: rng.choice(_field_choices(probe, f, n=n, batch=batch))
+                for f in fields}
+        cand = KernelVariant(family=family, **draw)
+        if variant_invalid(cand, n=n, batch=batch,
+                           prf_method=prf_method) is None:
+            return cand
+    return None
+
+
+# ----------------------------------------------------- gates & fitness
+
+
+def pallas_parity_ok(v: KernelVariant, *, prf_method: int,
+                     gate_n: int = 64, n_keys: int = 3,
+                     entry_size: int = 5) -> bool:
+    """Interpret-mode parity gate for one Pallas variant: the fused
+    grid kernel under this variant's structure, run EAGERLY through the
+    generic Pallas interpreter (CPU-safe), must be bit-identical to the
+    scan oracle on a small [R, K] grid with distinct keys.  Small on
+    purpose — the eager interpreter walks every grid cell in Python —
+    but structurally complete: multiple key tiles, multiple row steps,
+    a row0 offset via the tile walk, both codeword rows exercised."""
+    from ..core import sqrtn
+    from ..ops import pallas_sqrt
+    pairs = [sqrtn.generate_sqrt_keys((i * 71 + 3) % gate_n, gate_n,
+                                      b"ks%d" % i, prf_method)
+             for i in range(n_keys)]
+    keys = [p[0] for p in pairs] + [pairs[0][1]]
+    seeds, cw1, cw2 = sqrtn.pack_sqrt_keys(keys)
+    table = np.random.default_rng(gate_n).integers(
+        -2 ** 31, 2 ** 31, (gate_n, entry_size),
+        dtype=np.int64).astype(np.int32)
+    import jax.numpy as jnp
+    oracle = np.asarray(sqrtn.eval_contract_batched(
+        seeds, cw1, cw2, jnp.asarray(table), prf_method=prf_method,
+        kernel_impl="xla"))
+    try:
+        kw = dict(v.launcher_kwargs())
+        # a searched tb may exceed this small gate batch — the launcher
+        # pads up, so the structure under test is preserved
+        out = np.asarray(pallas_sqrt.sqrt_grid_contract_pallas(
+            seeds, cw1, cw2, jnp.asarray(table), prf_method=prf_method,
+            row_chunk=v.row_chunk, interpret=True, **kw))
+    except Exception:
+        return False
+    return out.shape == oracle.shape and np.array_equal(out, oracle)
+
+
+# ------------------------------------------------------------- search
+
+
+def kernel_search(n: int, batch: int, *, entry_size: int = 16,
+                  prf_method: int = PRF_CHACHA20, reps: int = 3,
+                  generations: int = 3, population: int = 6,
+                  distinct: int = 32, seed: int = 0,
+                  cache: TuningCache | None = None, force: bool = False,
+                  log=None) -> dict:
+    """Seeded mutate/tournament search over the kernel-variant space
+    for one (N, E, B, prf) shape; returns (and persists) the
+    ``kvariant`` cache record.
+
+    Seeding: the initial population always contains (a) the
+    staged-descent winner from ``tune_eval`` — run first, warm-cache
+    reused — (b) the static-heuristic knobs, and (c) the PR-10
+    hand-tuned Pallas structure, so the searched winner can never
+    regress any of them.  Each generation keeps the fastest half of the
+    timed family and refills with single-field mutations of survivors.
+
+    Fitness = best-of-``reps`` wall-clock through the REAL dispatch
+    path (``DPF.eval_tpu`` with the variant pinned into the searched
+    slot of the knob resolver, so the search exercises the same
+    consumption path serving uses), gated by full-output equality with
+    the scalar oracle.  Pallas variants race only where the kernel can
+    compile (TPU); elsewhere they are interpret-parity-gated and pinned
+    in the record (``pallas_pinned``) for the relay TPU session.
+    """
+    from ..api import DPF
+    from ..core.u128 import next_pow2
+    cache = cache if cache is not None else default_cache()
+    pb = next_pow2(batch)
+    key = cache_key(VARIANT_KIND, n=n, entry_size=entry_size, batch=pb,
+                    prf_method=prf_method, scheme="sqrtn", radix=2)
+    if not force:
+        rec = cache.lookup(key)
+        if rec is not None:
+            return {**rec, "searched": False}
+
+    rng = random.Random(0x5EED ^ seed ^ (n << 1) ^ batch)
+    # (a) the staged-descent seed (its own equality-gated search; a
+    # warm tuning cache answers without re-measuring)
+    descent = tune_eval(n, batch, entry_size=entry_size,
+                        prf_method=prf_method, scheme="sqrtn", radix=2,
+                        reps=reps, distinct=distinct, cache=cache,
+                        force=force, log=log)
+    dk = descent["knobs"]
+    seed_variant = KernelVariant(
+        family="pallas" if dk.get("kernel_impl") == "pallas" else "xla",
+        row_chunk=dk.get("row_chunk"), dot_impl=dk.get("dot_impl"))
+    if seed_variant.family == "pallas":
+        seed_variant = dataclasses.replace(
+            pr10_default_variant(), row_chunk=dk.get("row_chunk"),
+            dot_impl=dk.get("dot_impl"))
+    # (b) the static heuristics as an XLA-family variant
+    hk = heuristic_knobs(n, pb, prf_method=prf_method, scheme="sqrtn")
+    heur_variant = KernelVariant(family="xla",
+                                 row_chunk=hk.get("row_chunk"),
+                                 dot_impl=hk.get("dot_impl"))
+
+    table, keys, oracle = _workload(n, batch, entry_size, prf_method,
+                                    "sqrtn", 2, distinct)
+    tried = rejected = gate_escapes = 0
+    timings: dict[str, float] = {}
+
+    import jax
+    time_pallas = jax.default_backend() == "tpu"
+
+    def measure(v: KernelVariant) -> float | None:
+        """Equality-gate then time one variant through the real
+        dispatch path; None = rejected (counted, never timed)."""
+        nonlocal tried, rejected
+        tried += 1
+        # every knob the variant owns stays AUTO in the config (the
+        # EvalConfig defaults are explicit pins, which would outrank
+        # the searched slot) — resolution must answer
+        # kernel_resolved_from="searched" and run the variant
+        cfg = EvalConfig(prf_method=prf_method, batch_size=batch,
+                         radix=2, scheme="sqrtn", kernel_impl=None,
+                         dot_impl=None, row_chunk=None)
+        try:
+            with cfg.applied():
+                dpf = DPF(config=cfg)
+                dpf.eval_init(table)
+                # pin the variant into the SEARCHED slot of the knob
+                # memo: resolution answers kernel_resolved_from=
+                # "searched" and threads kernel_variant to the
+                # launcher — the exact consumption path serving uses
+                dpf._tuned_cache[dpf._pow2_domain(batch)] = {
+                    "_searched": v.eval_knobs()}
+                out = np.asarray(dpf.eval_tpu(keys))  # compile + warm
+                kn = dpf.resolved_eval_knobs(dpf._pow2_domain(batch))
+                if kn.get("kernel_resolved_from") != "searched":
+                    raise AssertionError(
+                        "variant pin did not resolve as searched "
+                        "(got %r) — the measurement would time the "
+                        "wrong program" % (kn,))
+                if out.shape != oracle.shape or not np.array_equal(
+                        out, oracle):
+                    rejected += 1
+                    if log:
+                        log("  reject (oracle mismatch): %s" % v.tag())
+                    return None
+                best = float("inf")
+                for _ in range(reps):
+                    t0 = time.perf_counter()
+                    np.asarray(dpf.eval_tpu(keys))
+                    best = min(best, time.perf_counter() - t0)
+            return best
+        except AssertionError:
+            raise  # a broken search harness, not a bad candidate
+        except Exception as exc:
+            rejected += 1
+            if log:
+                log("  reject (%s): %s" % (type(exc).__name__, v.tag()))
+            return None
+
+    # --- the timed tournament (XLA family; + Pallas where it compiles)
+    def timed_ok(v):
+        return v.family == "xla" or time_pallas
+
+    pop: list[KernelVariant] = []
+    for v in (seed_variant, heur_variant):
+        if timed_ok(v) and v not in pop:
+            pop.append(v)
+    fam = ["xla"] + (["pallas"] if time_pallas else [])
+    while len(pop) < population:
+        v = sample_variant(rng, fam[len(pop) % len(fam)], n=n,
+                           batch=pb, prf_method=prf_method)
+        if v is None:
+            break
+        if v not in pop:
+            pop.append(v)
+
+    scores: dict[KernelVariant, float] = {}
+    for gen in range(generations):
+        for v in pop:
+            if v in scores:
+                continue
+            bad = variant_invalid(v, n=n, batch=pb,
+                                  prf_method=prf_method)
+            if bad is not None:  # defensive: mutation pre-filters
+                rejected += 1
+                continue
+            t = measure(v)
+            if t is not None:
+                scores[v] = t
+                timings[v.tag()] = round(t, 6)
+                if log:
+                    log("  gen%d %-40s %.4fs" % (gen, v.tag(), t))
+        ranked = sorted((s for s in scores.items() if s[0] in pop),
+                        key=lambda s: s[1])
+        if gen == generations - 1:
+            break
+        survivors = [v for v, _ in ranked[:max(2, population // 2)]]
+        pop = list(survivors)
+        stale = 0
+        while len(pop) < population and stale < 4 * population:
+            child = mutate_variant(rng, rng.choice(survivors), n=n,
+                                   batch=pb, prf_method=prf_method)
+            if child is None or child in pop or child in scores:
+                stale += 1
+                continue
+            pop.append(child)
+
+    if not scores:
+        raise AssertionError(
+            "kernel search timed no candidate for n=%d batch=%d prf=%s"
+            % (n, batch, PRF_NAMES[prf_method]))
+    winner, winner_s = min(scores.items(), key=lambda s: s[1])
+    seed_s = scores.get(seed_variant)
+    heur_s = scores.get(heur_variant)
+
+    # --- the Pallas population: parity-gate every member (this is the
+    # gate that makes the search meaningful off-TPU; on TPU they also
+    # raced above).  Any parity failure is a correctness escape.
+    pallas_pop = [pr10_default_variant()]
+    from ..ops.pallas_sqrt import pallas_sqrt_unsupported
+    from ..core import sqrtn as _sq
+    _k, _r = _sq.default_split(n)
+    if pallas_sqrt_unsupported(prf_method, _r) is None:
+        while len(pallas_pop) < max(2, population // 2):
+            v = (mutate_variant(rng, rng.choice(pallas_pop), n=n,
+                                batch=pb, prf_method=prf_method)
+                 if rng.random() < 0.5 else
+                 sample_variant(rng, "pallas", n=n, batch=pb,
+                                prf_method=prf_method))
+            if v is not None and v not in pallas_pop:
+                pallas_pop.append(v)
+        gate_prf = prf_method
+    else:
+        # the timed prf has no Pallas plane core (DUMMY/AES) — gate the
+        # structural variants with the ChaCha core so the pinned
+        # population is still proven, and say so in the record
+        gate_prf = PRF_CHACHA20
+    pallas_parity = []
+    for v in pallas_pop:
+        ok = pallas_parity_ok(v, prf_method=gate_prf)
+        if not ok:
+            gate_escapes += 1
+        pallas_parity.append({"variant": v.to_dict(), "tag": v.tag(),
+                              "parity": bool(ok),
+                              "timed_s": (round(scores[v], 6)
+                                          if v in scores else None)})
+        if log:
+            log("  parity %-40s %s" % (v.tag(), "ok" if ok else "FAIL"))
+
+    record = {
+        "knobs": winner.eval_knobs(),
+        "variant_tag": winner.tag(),
+        "heuristic": hk,
+        "pallas_pinned": pallas_parity,
+        "pallas_gate_prf": PRF_NAMES[gate_prf],
+        "measured": {
+            "best_s": round(winner_s, 6),
+            "seed_s": round(seed_s, 6) if seed_s is not None else None,
+            "heuristic_s": (round(heur_s, 6)
+                            if heur_s is not None else None),
+            "speedup_vs_seed": (round(seed_s / winner_s, 4)
+                                if seed_s else None),
+            "speedup_vs_heuristic": (round(heur_s / winner_s, 4)
+                                     if heur_s else None),
+            "reps": reps, "generations": generations,
+            "population": population, "batch": batch, "entries": n,
+            "entry_size": entry_size, "prf": PRF_NAMES[prf_method],
+            "scheme": "sqrtn", "radix": 2,
+            "candidates_tried": tried, "rejected": rejected,
+            "gate_escapes": gate_escapes,
+            "pallas_timed": time_pallas,
+            "timings": timings,
+        },
+        "fingerprint": device_fingerprint(),
+        "gated": True,  # every timed candidate matched the scalar oracle
+    }
+    cache.store(key, record)
+    return {**record, "searched": True}
+
+
+# --------------------------------------------------------------- sweep
+
+
+def kernel_search_sweep(shapes=None, *, prf_method: int = PRF_CHACHA20,
+                        entry_size: int = 16, reps: int = 3,
+                        generations: int = 3, population: int = 6,
+                        force: bool = False, dryrun: bool = False,
+                        cache: TuningCache | None = None,
+                        out: str | None = None,
+                        quiet: bool = False) -> dict:
+    """``benchmark.py --autotune-kernel``: run :func:`kernel_search` per
+    (N, B) point and emit one self-describing JSON record (committed as
+    ``BENCH_KSEARCH_r15.json``).  ``--dryrun`` shrinks the shapes and
+    the search budget to a seconds-long CI smoke with the same record
+    shape (and the same invariants: 0 rejections, 0 gate escapes, a
+    persisted winner)."""
+    from .search import DEFAULT_SWEEP
+    compcache.enable()
+    cache = cache if cache is not None else default_cache()
+    log = None if quiet else (lambda m: print(m, flush=True))
+    if shapes is None:
+        shapes = ((256, 32),) if dryrun else DEFAULT_SWEEP
+    if dryrun:
+        reps, generations, population = 1, 2, 4
+    points = []
+    for n, batch in shapes:
+        if log:
+            log("kernel search n=%d batch=%d prf=%s ..."
+                % (n, batch, PRF_NAMES[prf_method]))
+        rec = kernel_search(
+            n, batch, entry_size=entry_size, prf_method=prf_method,
+            reps=reps, generations=generations, population=population,
+            distinct=8 if dryrun else 32, cache=cache, force=force,
+            log=log)
+        m = rec["measured"]
+        points.append({
+            "entries": n, "batch": batch,
+            "winner": rec["variant_tag"],
+            "winner_knobs": rec["knobs"],
+            "winner_s": m["best_s"], "seed_s": m["seed_s"],
+            "heuristic_s": m["heuristic_s"],
+            "speedup_vs_seed": m["speedup_vs_seed"],
+            "speedup_vs_heuristic": m["speedup_vs_heuristic"],
+            "winner_qps": int(batch / m["best_s"]),
+            "candidates_tried": m["candidates_tried"],
+            "rejected": m["rejected"],
+            "gate_escapes": m["gate_escapes"],
+            "pallas_timed": m["pallas_timed"],
+            "pallas_pinned": rec["pallas_pinned"],
+            "pallas_all_parity": all(p["parity"]
+                                     for p in rec["pallas_pinned"]),
+            "from_cache": not rec["searched"],
+        })
+    record = {
+        "metric": "generative kernel-variant search (seeded mutate/"
+                  "tournament, equality-gated, best-of-%d reps; Pallas "
+                  "family interpret-parity-gated and pinned)" % reps,
+        "fingerprint": device_fingerprint(),
+        "prf": PRF_NAMES[prf_method],
+        "dryrun": dryrun,
+        "points": points,
+        "tuning_cache": cache.path,
+        "compilation_cache": compcache.enabled_dir(),
+        "cache_counters": CACHE_COUNTERS.as_dict(),
+        # checked: every timed candidate passed the scalar-oracle gate
+        # AND every pinned Pallas variant passed interpret parity
+        "checked": (all(p["gate_escapes"] == 0 for p in points)
+                    and all(p["pallas_all_parity"] for p in points)),
+    }
+    if not quiet:
+        print(json.dumps(record), flush=True)
+    if out:
+        with open(out, "w") as f:
+            json.dump(record, f, indent=1)
+            f.write("\n")
+    return record

@@ -269,3 +269,83 @@ def test_multihost_bench_simulated_smoke():
     for leg in ("chaos_degrade_leg", "chaos_reshard_leg"):
         assert rec[leg]["availability"] >= 0.95
         assert rec[leg]["drop_attributed"]
+
+
+# ------------------------------------------- batch-PIR group routing
+
+def _pir_setup(hosts=3, scheme="logn", routed=True, seed=0):
+    from dpf_tpu.apps.batch_pir import (PrivateLookupClient,
+                                        PrivateLookupServer)
+    from dpf_tpu.parallel.cluster import ClusterPIRRouter
+
+    rng = np.random.default_rng(seed)
+    table = rng.integers(0, 2 ** 31, size=(2048, 5), dtype=np.int32)
+    universe = rng.permutation(2048)
+    bins, off = [], 0
+    for sz in (300, 260, 130, 120, 60, 50, 20):
+        bins.append(universe[off:off + sz].tolist())
+        off += sz
+    sa = PrivateLookupServer(table, bins, prf=DPF.PRF_DUMMY,
+                             scheme=scheme)
+    sb = PrivateLookupServer(table, bins, prf=DPF.PRF_DUMMY,
+                             scheme=scheme)
+    client = PrivateLookupClient(bins, sa.bin_sizes, prf=DPF.PRF_DUMMY,
+                                 scheme=scheme)
+    router = ClusterPIRRouter(table, bins, hosts=hosts,
+                              prf=DPF.PRF_DUMMY, scheme=scheme,
+                              routed=routed)
+    return table, bins, sa, sb, client, router
+
+
+def test_pir_group_routing_bit_parity_vs_broadcast_and_oracle():
+    """The satellite gate: routed dispatch (each size group only to its
+    owner hosts) is bit-identical to the broadcast replay AND to the
+    single-server oracle, end-to-end through client recovery."""
+    table, bins, sa, sb, client, routed = _pir_setup(routed=True)
+    bcast = _pir_setup(routed=False)[-1]
+    wanted = [b[len(b) // 2] for b in bins]
+    ka, kb, plan = client.make_queries(wanted)
+    ans_oracle = np.asarray(sa.answer(ka))
+    ans_routed = routed.answer(ka)
+    ans_bcast = bcast.answer(ka)
+    assert np.array_equal(ans_routed, ans_oracle)
+    assert np.array_equal(ans_bcast, ans_oracle)
+    rec = client.recover(ans_routed, np.asarray(sb.answer(kb)), plan)
+    for t in wanted:
+        assert np.array_equal(rec[t], table[t])
+
+
+def test_pir_group_routing_reduces_dispatches():
+    """Routing strictly reduces per-host size-group deliveries vs the
+    broadcast baseline, and only owner hosts receive a group."""
+    _, bins, _, _, client, routed = _pir_setup(routed=True)
+    bcast = _pir_setup(routed=False)[-1]
+    ka, _, _ = client.make_queries([b[0] for b in bins])
+    seq0 = FLIGHT.recorded
+    routed.answer(ka)
+    bcast.answer(ka)
+    r_total = sum(routed.dispatch_counts.values())
+    b_total = sum(bcast.dispatch_counts.values())
+    assert r_total < b_total
+    n_groups = len(routed.group_sizes)
+    assert b_total == n_groups * len(bcast.dispatch_counts)
+    for lb, got in routed.dispatch_counts.items():
+        assert got == len(routed.host_groups(lb))
+    evs = [e for e in flight_dump()
+           if e["seq"] > seq0 and e["kind"] == "pir_scatter"]
+    assert [e["routed"] for e in evs] == [True, False]
+    assert evs[0]["dispatches"] == r_total
+
+
+def test_pir_router_rejects_auto_scheme_and_covers_every_bin():
+    from dpf_tpu.parallel.cluster import ClusterPIRRouter
+    table = np.zeros((256, 2), np.int32)
+    bins = [[1, 2], [3, 4, 5]]
+    with pytest.raises(ValueError, match="auto"):
+        ClusterPIRRouter(table, bins, scheme="auto")
+    r = ClusterPIRRouter(table, bins, hosts=4, scheme="logn")
+    owned = [bi for _, _, idxs in r._hosts for bi in idxs]
+    assert sorted(owned) == list(range(len(bins)))
+    # more hosts than bins: empty hosts exist but never panic
+    with pytest.raises(ValueError, match="one key per bin"):
+        r.answer([b"x"])
