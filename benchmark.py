@@ -15,6 +15,7 @@
 #   --chaos            serve/bench_chaos.py         BENCH_CHAOS_r11.json
 #   --trace            obs/bench_trace.py           BENCH_TRACE_r12.json
 #   --multihost        serve/bench_multihost.py     MULTIHOST_r14.json
+#   --multitenant      serve/bench_multitenant.py   MULTITENANT_r16.json
 #
 # --serve: streaming serving benchmark (blocking loop vs pipelined
 # ServingEngine).  See docs/SERVING.md.
@@ -72,6 +73,15 @@
 # in-process tier), availability + decision attribution via the flight
 # recorder, every merged answer gated against the scalar oracle;
 # --dryrun is the seconds-long CI smoke.  See docs/MULTIHOST.md.
+#
+# --multitenant: multi-tenant serving isolation — >= 3 distinct-(N,E)
+# tenant tables (plus one table-sharing tenant) behind one
+# TenantRouter (serve/tenant.py) over a TableRegistry, replayed solo /
+# combined / noisy-neighbor-chaos (4x victim burst + seeded fault
+# plan); gates that every non-victim holds availability 1.0 and p99
+# within 1.5x of its solo baseline while the victim degrades, every
+# served batch gated against the scalar oracle; --dryrun is the
+# seconds-long CI smoke.  See docs/MULTITENANT.md.
 #
 # --trace: end-to-end observability — span tracing over the serving
 # path with a joint host+device digest for one tuned shape, the
@@ -203,6 +213,10 @@ if __name__ == "__main__":
     if "--chaos" in sys.argv:
         from dpf_tpu.serve.bench_chaos import main
         main([a for a in sys.argv[1:] if a != "--chaos"])
+        sys.exit(0)
+    if "--multitenant" in sys.argv:
+        from dpf_tpu.serve.bench_multitenant import main
+        main([a for a in sys.argv[1:] if a != "--multitenant"])
         sys.exit(0)
     if "--trace" in sys.argv:
         from dpf_tpu.obs.bench_trace import main

@@ -41,24 +41,49 @@ measures the full observability stack under 2% of qps.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from collections import deque
 
-#: bounded flight-ring capacity (events, not queries)
+#: default bounded flight-ring capacity (events, not queries)
 FLIGHT_RING = 2048
+
+
+def _env_capacity(name: str, default: int) -> int:
+    """Positive-int ring capacity from the environment, else the
+    default (a malformed value must never break recorder import)."""
+    try:
+        v = int(os.environ.get(name, ""))
+        return v if v > 0 else default
+    except (TypeError, ValueError):
+        return default
 
 
 class FlightRecorder:
     """Thread-safe bounded event ring; one process-wide instance
-    (``FLIGHT``) is the default everywhere."""
+    (``FLIGHT``) is the default everywhere.
 
-    def __init__(self, capacity: int = FLIGHT_RING):
+    ``capacity`` defaults to ``DPF_FLIGHT_RING`` from the environment
+    (else ``FLIGHT_RING``) — a busy multi-tenant process can widen the
+    ring without code changes.  ``dropped`` counts events evicted from
+    a full ring (exported as ``dpf_flight_events_dropped_total``), so
+    ring overrun is visible instead of silently losing
+    fault-attribution evidence."""
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = _env_capacity("DPF_FLIGHT_RING", FLIGHT_RING)
         self._ring = deque(maxlen=int(capacity))
         self._lock = threading.Lock()
         self._t0 = time.monotonic()
         self.recorded = 0           # total ever recorded (ring evicts)
+        self.dropped = 0            # events evicted from the full ring
         self._process = None        # jax process_index label (multi-host)
+
+    @property
+    def capacity(self) -> int:
+        return self._ring.maxlen
 
     def set_process(self, index: int | None) -> None:
         """Stamp every subsequent event with a ``process`` label — the
@@ -78,6 +103,8 @@ class FlightRecorder:
             with self._lock:
                 self.recorded += 1
                 ev["seq"] = self.recorded
+                if len(self._ring) == self._ring.maxlen:
+                    self.dropped += 1
                 self._ring.append(ev)
         except Exception:
             pass

@@ -427,15 +427,28 @@ def engine_samples(counters, labels: dict) -> list:
     return out
 
 
+def _with_tenant(labels: dict, obj) -> dict:
+    """Merge an object's ``tenant`` attribute into a sample's labels —
+    the multi-tenant tier (serve/tenant.py) stamps routers, engines and
+    breakers so every per-tenant series is filterable by ``tenant=``."""
+    t = getattr(obj, "tenant", None)
+    if t is None or "tenant" in labels:
+        return labels
+    out = dict(labels)
+    out["tenant"] = str(t)
+    return out
+
+
 def register_engine(engine, registry: MetricsRegistry | None = None):
     """Export one engine's ``EngineCounters`` as
-    ``dpf_engine_*{engine=...}`` series (weakly held)."""
+    ``dpf_engine_*{engine=...}`` series (weakly held; a ``tenant``
+    attribute on the engine adds a ``tenant=`` label)."""
     reg = registry or REGISTRY
     label = getattr(engine, "label", None) or "engine-%x" % id(engine)
 
     def emit(e):
-        return engine_samples(e.stats, _with_process(
-            {"engine": label}, getattr(e, "process_index", None)))
+        return engine_samples(e.stats, _with_tenant(_with_process(
+            {"engine": label}, getattr(e, "process_index", None)), e))
     reg.watch(engine, emit)
 
 
@@ -475,7 +488,8 @@ def register_router(router, registry: MetricsRegistry | None = None):
             out.append(("dpf_router_routed_from", "counter",
                         "routing-decision provenance",
                         {"source": src}, float(c)))
-        return [(n, k, h, _with_process(l), v) for n, k, h, l, v in out]
+        return [(n, k, h, _with_tenant(_with_process(l), r), v)
+                for n, k, h, l, v in out]
     reg.watch(router, emit)
 
 
@@ -517,6 +531,74 @@ def register_cluster(cluster, registry: MetricsRegistry | None = None):
     reg.watch(cluster, emit)
 
 
+def register_table_registry(registry_obj,
+                            registry: MetricsRegistry | None = None):
+    """Export a ``serve.registry.TableRegistry``'s residency state —
+    budget/resident bytes, promotion/demotion/eviction counters and a
+    per-(table, version) residency gauge — as ``dpf_registry_*`` series
+    (weakly held)."""
+    reg = registry or REGISTRY
+
+    def emit(r):
+        out = []
+        st = r.stats()
+        if st["budget_bytes"] is not None:
+            out.append(("dpf_registry_budget_bytes", "gauge",
+                        "configured device-residency byte budget", {},
+                        float(st["budget_bytes"])))
+        out.append(("dpf_registry_resident_bytes", "gauge",
+                    "device bytes currently resident", {},
+                    float(st["resident_bytes"])))
+        for f in ("promotions", "demotions", "evictions",
+                  "deferred_demotions", "hits", "misses",
+                  "overcommits"):
+            out.append(("dpf_registry_" + f, "counter",
+                        "TableRegistry residency counter", {},
+                        float(st["counters"][f])))
+        for row in st["tables"]:
+            out.append(("dpf_registry_table_resident", "gauge",
+                        "1=device-resident 0=demoted to host RAM",
+                        {"table": row["name"],
+                         "version": row["version"]},
+                        1.0 if row["resident"] else 0.0))
+        return [(n, k, h, _with_process(l), v) for n, k, h, l, v in out]
+    reg.watch(registry_obj, emit)
+
+
+def register_tenants(tenant_router,
+                     registry: MetricsRegistry | None = None):
+    """Export a ``serve.tenant.TenantRouter``'s scheduler state — queue
+    depth, in-flight quota usage, DRR deficit, weight and the
+    dispatch/shed counters — as ``dpf_tenant_*{tenant=...}`` series
+    (weakly held).  The per-tenant ``SchemeRouter``s and engines
+    self-register their own series with the ``tenant=`` label."""
+    reg = registry or REGISTRY
+
+    def emit(tr):
+        out = []
+        for name, ts in tr.tenants.items():
+            labels = {"tenant": name}
+            out.append(("dpf_tenant_weight", "gauge",
+                        "weighted-fair scheduling weight", labels,
+                        float(ts.spec.weight)))
+            out.append(("dpf_tenant_queue_depth", "gauge",
+                        "batches pending in the tenant queue", labels,
+                        float(len(ts.queue))))
+            out.append(("dpf_tenant_in_flight", "gauge",
+                        "dispatched-but-unresolved batches", labels,
+                        float(ts.in_flight)))
+            out.append(("dpf_tenant_deficit", "gauge",
+                        "deficit-round-robin credit (queries)", labels,
+                        float(ts.deficit)))
+            for f in ("submitted", "dispatched", "shed_batches",
+                      "shed_queries", "quota_defers"):
+                out.append(("dpf_tenant_" + f, "counter",
+                            "tenant scheduler counter", labels,
+                            float(getattr(ts, f))))
+        return [(n, k, h, _with_process(l), v) for n, k, h, l, v in out]
+    reg.watch(tenant_router, emit)
+
+
 def _process_samples():
     """CacheCounters + SWALLOWED_ERRORS + tracer/flight meta — the
     process-wide series, registered once at import."""
@@ -547,6 +629,10 @@ def _process_samples():
     out.append(("dpf_flight_events", "counter",
                 "events landed in the flight recorder", {},
                 float(FLIGHT.recorded)))
+    out.append(("dpf_flight_events_dropped", "counter",
+                "events evicted from the full flight ring "
+                "(widen with DPF_FLIGHT_RING)", {},
+                float(getattr(FLIGHT, "dropped", 0))))
     return out
 
 

@@ -42,6 +42,8 @@ import threading
 import time
 from collections import deque
 
+from .flight import _env_capacity
+
 #: default bounded span-ring capacity per tracer
 SPAN_RING = 8192
 
@@ -117,7 +119,9 @@ class Tracer:
     spans on separate tracks.
     """
 
-    def __init__(self, capacity: int = SPAN_RING):
+    def __init__(self, capacity: int | None = None):
+        if capacity is None:
+            capacity = _env_capacity("DPF_SPAN_RING", SPAN_RING)
         self._ring = deque(maxlen=int(capacity))
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
@@ -239,10 +243,13 @@ class Tracer:
 _TRACER: Tracer | None = None
 
 
-def enable(capacity: int = SPAN_RING) -> Tracer:
+def enable(capacity: int | None = None) -> Tracer:
     """Install (and return) the process tracer; idempotent unless a
-    different capacity is requested."""
+    different capacity is requested.  ``capacity=None`` resolves the
+    ``DPF_SPAN_RING`` environment knob (else ``SPAN_RING``)."""
     global _TRACER
+    if capacity is None:
+        capacity = _env_capacity("DPF_SPAN_RING", SPAN_RING)
     if _TRACER is None or _TRACER._ring.maxlen != int(capacity):
         _TRACER = Tracer(capacity)
     return _TRACER

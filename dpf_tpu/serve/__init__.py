@@ -16,6 +16,14 @@ router against the sticky baseline under a traffic trace with SLO
 accounting, and ``bench_chaos.py`` replays that trace under escalating
 fault plans to measure availability.  Constructed via
 ``DPF.serving_engine()`` or ``ShardedDPFServer.serving_engine()``.
+
+The multi-tenant tier sits on top: ``TableRegistry`` (registry.py)
+holds named, versioned tables with LRU device residency against a byte
+budget, ``TenantRouter`` (tenant.py) runs one isolated ``SchemeRouter``
+per tenant (per-tenant breakers/admission/SLO, tenant-labeled
+flight/metrics) under a weighted-fair deficit-round-robin scheduler,
+and ``bench_multitenant.py`` gates the noisy-neighbor isolation claim
+(``benchmark.py --multitenant``).
 """
 
 from .buckets import Buckets  # noqa: F401
@@ -25,4 +33,6 @@ from .faults import (CircuitBreaker, EngineDead, EngineSupervisor,  # noqa: F401
                      InjectedCompileError, InjectedDispatchError,
                      RetryPolicy, submit_with_retry)
 from .loadgen import Arrival, make_trace  # noqa: F401
+from .registry import TableLease, TableRegistry, TableVersion  # noqa: F401
 from .router import RouteDecision, SchemeRouter  # noqa: F401
+from .tenant import TenantFuture, TenantRouter, TenantSpec  # noqa: F401

@@ -135,7 +135,8 @@ class ServingEngine:
                  timeout_s: float | None = None,
                  max_queue_depth: int | None = None,
                  slo_s: float | None = None, shed: bool = False,
-                 label: str | None = None, injector=None):
+                 label: str | None = None, injector=None,
+                 tenant: str | None = None):
         if max_in_flight < 1:
             raise ValueError("max_in_flight must be >= 1 (got %d)"
                              % max_in_flight)
@@ -170,6 +171,7 @@ class ServingEngine:
         self.slo_s = slo_s
         self.shed = bool(shed)
         self.label = label
+        self.tenant = tenant      # owning tenant (metrics/flight labels)
         self._injector = injector
         self.stats = EngineCounters()
         self._queue = deque()     # _Part refs, dispatch order, unresolved
@@ -442,9 +444,12 @@ class ServingEngine:
         # deadline spuriously nor starve it forever
         if self.deadline is not None and time.monotonic() > self.deadline:
             self.stats.deadline_misses += 1
-            FLIGHT.record("deadline", engine=self.label or "engine",
-                          pending=len(self._pending),
-                          in_flight=len(self._queue))
+            ev = dict(engine=self.label or "engine",
+                      pending=len(self._pending),
+                      in_flight=len(self._queue))
+            if self.tenant is not None:
+                ev["tenant"] = self.tenant
+            FLIGHT.record("deadline", **ev)
             raise DeadlineExceeded(
                 "serving-engine deadline passed between dispatches")
 
@@ -467,12 +472,14 @@ class ServingEngine:
         if self.shed and (over_depth or over_slo):
             self.stats.shed_batches += 1
             self.stats.shed_queries += n_queries
-            FLIGHT.record("shed", engine=self.label or "engine",
-                          batch=n_queries,
-                          reason=("queue_depth" if over_depth
-                                  else "p99_over_slo"),
-                          pending=len(self._pending),
-                          p99=self.stats.p99, slo_s=self.slo_s)
+            ev = dict(engine=self.label or "engine", batch=n_queries,
+                      reason=("queue_depth" if over_depth
+                              else "p99_over_slo"),
+                      pending=len(self._pending),
+                      p99=self.stats.p99, slo_s=self.slo_s)
+            if self.tenant is not None:
+                ev["tenant"] = self.tenant
+            FLIGHT.record("shed", **ev)
             raise LoadShed(
                 "admission control rejected the batch (%s; pending=%d, "
                 "p99=%s, slo_s=%s)"

@@ -132,11 +132,21 @@ class FaultSpec:
         return {k: v for k, v in dataclasses.asdict(self).items()
                 if v is not None}
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultSpec":
+        """Inverse of ``as_dict`` (``as_dict`` drops None fields, so a
+        round-trip restores the dataclass defaults for them)."""
+        fields = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in fields})
+
 
 class FaultPlan:
     """An immutable list of ``FaultSpec`` plus the seed that makes every
     injection decision reproducible.  ``injector()`` mints the runtime
-    object the engines consult."""
+    object the engines consult.  ``as_dict``/``from_dict`` round-trip
+    the full plan, so a committed chaos-style bench record (which embeds
+    ``record["faults"]["plan"]``) names an exactly replayable fault
+    sequence."""
 
     def __init__(self, specs=(), seed: int = 0):
         self.specs = tuple(specs)
@@ -148,6 +158,12 @@ class FaultPlan:
     def as_dict(self) -> dict:
         return {"seed": self.seed,
                 "specs": [s.as_dict() for s in self.specs]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        return cls(specs=[FaultSpec.from_dict(s)
+                          for s in d.get("specs", ())],
+                   seed=d.get("seed", 0))
 
 
 class FaultInjector:
@@ -164,6 +180,7 @@ class FaultInjector:
     def __init__(self, plan: FaultPlan):
         self.plan = plan
         self.arrival = -1             # -1 = outside any arrival (warmup)
+        self.tenant = None            # set by TenantRouter (flight label)
         self.injected = {k: 0 for k in KINDS}
         self.corruptions = []         # (construction, arrival)
         self._consults = {}           # (spec_idx, arrival) -> count
@@ -213,9 +230,11 @@ class FaultInjector:
                 # flight-record every fire with the SAME arrival index
                 # the route decision carries — the join key that
                 # attributes a fault to the decision that placed it
-                FLIGHT.record("fault", fault=spec.kind,
-                              construction=label, bucket=bucket,
-                              arrival=self.arrival)
+                ev = dict(fault=spec.kind, construction=label,
+                          bucket=bucket, arrival=self.arrival)
+                if self.tenant is not None:
+                    ev["tenant"] = self.tenant
+                FLIGHT.record("fault", **ev)
                 yield spec
 
     # ----------------------------------------------- injection points
@@ -377,12 +396,14 @@ class CircuitBreaker:
     STATES = ("closed", "open", "half_open")
 
     def __init__(self, failures: int = 3, reset_s: float = 30.0,
-                 on_open=None, name: str | None = None):
+                 on_open=None, name: str | None = None,
+                 tenant: str | None = None):
         if failures < 1:
             raise ValueError("failures must be >= 1 (got %d)" % failures)
         self.failures = int(failures)
         self.reset_s = float(reset_s)
         self.name = name              # construction label (flight events)
+        self.tenant = tenant          # owning tenant (flight events)
         self.state = "closed"
         self.consecutive = 0
         self.opened_at = None
@@ -401,9 +422,11 @@ class CircuitBreaker:
         self.state = state
         self.transitions.append(
             (round(time.monotonic() - self._t0, 4), state))
-        FLIGHT.record("breaker", breaker=self.name or "breaker",
-                      frm=prev, to=state,
-                      consecutive_failures=self.consecutive)
+        ev = dict(breaker=self.name or "breaker", frm=prev, to=state,
+                  consecutive_failures=self.consecutive)
+        if self.tenant is not None:
+            ev["tenant"] = self.tenant
+        FLIGHT.record("breaker", **ev)
         if state == "open" and self.on_open is not None:
             self.on_open(self)
 

@@ -1,0 +1,327 @@
+"""Table registry: named, versioned tables with LRU device residency.
+
+Serving millions of users means many tables under one process — more
+table bytes than the accelerator holds.  The registry is the residency
+arbiter the multi-tenant tier (``serve/tenant.py``) builds on:
+
+* **Named + versioned** — ``register(name, table)`` uploads a new
+  version (monotonic per name); ``acquire(name)`` answers with the
+  latest (or a pinned explicit version), so a tenant can roll a table
+  forward while in-flight queries finish against the version they
+  started on.
+* **Byte-budgeted LRU residency** — each version's prepared servers
+  (one ``api.DPF`` per construction, ``build_servers``) keep the table
+  device-resident while hot.  A configurable ``budget_bytes`` bounds
+  total resident bytes: registering or re-promoting past the budget
+  demotes the least-recently-used unpinned version to host RAM
+  (``DPF.eval_free`` — the padded host table survives on the server),
+  and a later ``acquire`` re-promotes it with a bit-identical
+  ``eval_init`` re-upload.
+* **Pinned versions** — ``acquire`` returns a ``TableLease`` (context
+  manager) that PINS the version: a pinned version is never demoted out
+  from under in-flight queries — eviction pressure marks it
+  ``demote_pending`` and the demotion runs when the last lease
+  releases.
+* **Observable** — every promotion/demotion/eviction/overcommit is a
+  ``FLIGHT.record("registry", ...)`` event and a counter
+  (``note_swallowed``-style: counting never raises into the serving
+  path), exported as ``dpf_registry_*`` metrics
+  (``obs.metrics.register_table_registry``).
+
+Budget accounting counts the POST-PADDING device bytes of every
+construction layout (each construction uploads its own permutation of
+the same table), so the resident-bytes gauge is what the device
+actually holds, not what the caller passed in.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..obs.flight import FLIGHT
+from ..utils.profiling import note_swallowed
+from .router import LABELS, build_servers
+
+#: registry counter names (all monotonic)
+COUNTER_NAMES = ("registrations", "promotions", "demotions", "evictions",
+                 "deferred_demotions", "hits", "misses", "overcommits")
+
+
+class TableVersion:
+    """One registered (name, version): the host table, its prepared
+    per-construction servers, and its residency state."""
+
+    __slots__ = ("name", "version", "table", "servers", "nbytes",
+                 "resident", "pins", "demote_pending", "last_used")
+
+    def __init__(self, name, version, table, servers):
+        self.name = name
+        self.version = int(version)
+        self.table = table            # caller's [N, E] host table
+        self.servers = servers        # label -> prepared api.DPF
+        # post-padding device bytes across every construction layout
+        self.nbytes = sum(int(s.table.nbytes) for s in servers.values())
+        self.resident = True
+        self.pins = 0
+        self.demote_pending = False
+        self.last_used = 0            # registry LRU sequence
+
+    @property
+    def key(self) -> tuple:
+        return (self.name, self.version)
+
+    def __repr__(self):
+        return ("TableVersion(%s@v%d, %.1f MiB, %s%s, pins=%d)"
+                % (self.name, self.version, self.nbytes / 2 ** 20,
+                   "resident" if self.resident else "host-ram",
+                   ", demote_pending" if self.demote_pending else "",
+                   self.pins))
+
+
+class TableLease:
+    """A pinned acquisition of one table version (context manager).
+
+    While held, the version's device residency is guaranteed: queries
+    dispatched through ``servers`` complete against the pinned upload
+    even if eviction pressure arrives mid-flight (the demotion defers
+    to the last release).  Idempotent ``release``.
+    """
+
+    __slots__ = ("_registry", "_tv", "_released")
+
+    def __init__(self, registry, tv):
+        self._registry = registry
+        self._tv = tv
+        self._released = False
+
+    @property
+    def name(self) -> str:
+        return self._tv.name
+
+    @property
+    def version(self) -> int:
+        return self._tv.version
+
+    @property
+    def servers(self) -> dict:
+        return self._tv.servers
+
+    def server(self, label: str):
+        return self._tv.servers[label]
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._registry._release(self._tv)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class TableRegistry:
+    """Thread-safe named/versioned table store with LRU residency.
+
+    Args:
+      budget_bytes: total device bytes the registry may keep resident
+        (None = unbounded).  Registering or promoting past the budget
+        demotes LRU unpinned versions first; when everything else is
+        pinned the registry OVERCOMMITS (serving in-flight traffic
+        beats enforcing the budget) and counts it.
+      labels: construction labels each version prepares
+        (``router.LABELS`` by default — the full router race).
+      prf_method: PRF id shared by every prepared server.
+    """
+
+    def __init__(self, budget_bytes: int | None = None, *,
+                 labels=LABELS, prf_method: int = 0):
+        self.budget_bytes = (None if budget_bytes is None
+                             else int(budget_bytes))
+        self.labels = tuple(labels)
+        self.prf_method = int(prf_method)
+        self._tables = {}         # name -> {version -> TableVersion}
+        self._lock = threading.RLock()
+        self._seq = 0             # LRU clock
+        self.counters = {k: 0 for k in COUNTER_NAMES}
+        try:
+            from ..obs.metrics import register_table_registry
+            register_table_registry(self)
+        except Exception as e:  # observability must never break serving
+            note_swallowed("serve.registry.register_metrics", e)
+
+    # ----------------------------------------------------- registration
+
+    def register(self, name: str, table, version: int | None = None
+                 ) -> TableVersion:
+        """Upload ``table`` as a new version of ``name`` (monotonic
+        version number when None).  Makes budget room FIRST (the new
+        upload is the hottest thing in the process), then builds one
+        prepared server per construction."""
+        table = np.asarray(table)
+        with self._lock:
+            versions = self._tables.setdefault(name, {})
+            if version is None:
+                version = max(versions, default=0) + 1
+            version = int(version)
+            if version in versions:
+                raise ValueError("table %r version %d already registered"
+                                 % (name, version))
+            self._ensure_budget(self._estimate_bytes(table))
+            servers = build_servers(table, self.labels,
+                                    prf_method=self.prf_method)
+            tv = TableVersion(name, version, table, servers)
+            self._touch(tv)
+            versions[version] = tv
+            self.counters["registrations"] += 1
+            self._event("register", tv)
+            return tv
+
+    def _estimate_bytes(self, table) -> int:
+        """Device bytes ``register`` will occupy: per-construction
+        padded int32 layout (the pow-of-two pad rule of
+        ``DPF.eval_init``)."""
+        n, e = table.shape
+        if n & (n - 1) != 0:
+            n = 1 << n.bit_length()
+        return n * e * 4 * len(self.labels)
+
+    # ------------------------------------------------------- residency
+
+    def acquire(self, name: str, version: int | None = None
+                ) -> TableLease:
+        """Pin (and, when cold, re-promote) a version; latest when
+        ``version`` is None.  Returns a ``TableLease``."""
+        with self._lock:
+            tv = self._get(name, version)
+            if tv.resident:
+                self.counters["hits"] += 1
+            else:
+                self.counters["misses"] += 1
+                self._promote(tv)
+            tv.pins += 1
+            self._touch(tv)
+            return TableLease(self, tv)
+
+    def demote(self, name: str, version: int | None = None) -> bool:
+        """Demote a version's device residency to host RAM.  A pinned
+        version only gets ``demote_pending`` (in-flight queries finish
+        against the pinned upload; the demotion runs at last release).
+        Returns True when the demotion happened now."""
+        with self._lock:
+            tv = self._get(name, version)
+            return self._demote(tv, action="demote")
+
+    def _get(self, name, version) -> TableVersion:
+        versions = self._tables.get(name)
+        if not versions:
+            raise KeyError("no table registered as %r" % (name,))
+        if version is None:
+            version = max(versions)
+        if version not in versions:
+            raise KeyError("table %r has no version %s (have %s)"
+                           % (name, version, sorted(versions)))
+        return versions[version]
+
+    def _touch(self, tv) -> None:
+        self._seq += 1
+        tv.last_used = self._seq
+
+    def _promote(self, tv) -> None:
+        """Re-upload a demoted version (bit-identical: ``eval_init``
+        over the SAME padded host table each server kept)."""
+        self._ensure_budget(tv.nbytes, keep=tv)
+        for srv in tv.servers.values():
+            srv.eval_init(srv.table)
+        tv.resident = True
+        tv.demote_pending = False
+        self.counters["promotions"] += 1
+        self._event("promote", tv)
+
+    def _demote(self, tv, action: str) -> bool:
+        if not tv.resident:
+            return False
+        if tv.pins > 0:
+            if not tv.demote_pending:
+                tv.demote_pending = True
+                self.counters["deferred_demotions"] += 1
+                self._event("demote_deferred", tv)
+            return False
+        for srv in tv.servers.values():
+            srv.eval_free()
+        tv.resident = False
+        tv.demote_pending = False
+        self.counters["demotions"] += 1
+        if action == "evict":
+            self.counters["evictions"] += 1
+        self._event(action, tv)
+        return True
+
+    def _ensure_budget(self, need: int, keep=None) -> None:
+        """Demote LRU resident unpinned versions until ``need`` more
+        bytes fit; overcommit (counted) when everything left is
+        pinned."""
+        if self.budget_bytes is None:
+            return
+        while self.resident_bytes + need > self.budget_bytes:
+            victims = [tv for tv in self._versions()
+                       if tv.resident and tv.pins == 0
+                       and tv is not keep]
+            if not victims:
+                self.counters["overcommits"] += 1
+                FLIGHT.record("registry", action="overcommit",
+                              need_bytes=int(need),
+                              resident_bytes=self.resident_bytes,
+                              budget_bytes=self.budget_bytes)
+                return
+            self._demote(min(victims, key=lambda tv: tv.last_used),
+                         action="evict")
+
+    def _release(self, tv) -> None:
+        with self._lock:
+            tv.pins = max(0, tv.pins - 1)
+            if tv.pins == 0 and tv.demote_pending:
+                self._demote(tv, action="demote")
+
+    # -------------------------------------------------------- plumbing
+
+    def _versions(self):
+        for versions in self._tables.values():
+            yield from versions.values()
+
+    @property
+    def resident_bytes(self) -> int:
+        with self._lock:
+            return sum(tv.nbytes for tv in self._versions()
+                       if tv.resident)
+
+    def _event(self, action: str, tv) -> None:
+        FLIGHT.record("registry", action=action, table=tv.name,
+                      version=tv.version, bytes=tv.nbytes,
+                      pins=tv.pins, resident_bytes=self.resident_bytes)
+
+    def stats(self) -> dict:
+        """JSON-ready registry snapshot (benchmark records embed it)."""
+        with self._lock:
+            return {
+                "budget_bytes": self.budget_bytes,
+                "resident_bytes": self.resident_bytes,
+                "counters": dict(self.counters),
+                "tables": [{"name": tv.name, "version": tv.version,
+                            "bytes": tv.nbytes,
+                            "resident": tv.resident, "pins": tv.pins,
+                            "demote_pending": tv.demote_pending}
+                           for tv in sorted(self._versions(),
+                                            key=lambda t: t.key)],
+            }
+
+    def __repr__(self):
+        st = self.stats()
+        return ("TableRegistry(%d tables, %.1f/%s MiB resident)"
+                % (len(st["tables"]), st["resident_bytes"] / 2 ** 20,
+                   "inf" if self.budget_bytes is None
+                   else "%.1f" % (self.budget_bytes / 2 ** 20)))

@@ -205,7 +205,8 @@ class SchemeRouter:
                  retry: RetryPolicy | None = None,
                  breaker_failures: int = 5,
                  breaker_reset_s: float = 30.0,
-                 supervise: bool = False):
+                 supervise: bool = False,
+                 tenant: str | None = None):
         from ..api import DPF
         if not 0 < ewma_alpha <= 1:
             raise ValueError("ewma_alpha must be in (0, 1] (got %r)"
@@ -250,11 +251,15 @@ class SchemeRouter:
                                      else Buckets.default_sizes(cap)))
         self.injector = injector
         self.retry = retry
+        self.tenant = tenant    # owning tenant (metrics/flight labels)
+        if injector is not None and tenant is not None:
+            injector.tenant = tenant
         # kept for EngineSupervisor rebuilds: a fresh engine must get
-        # the SAME admission knobs as the one it replaces
+        # the SAME admission knobs (and tenant label) as the one it
+        # replaces
         self._engine_kw = dict(max_in_flight=max_in_flight,
                                max_queue_depth=max_queue_depth,
-                               slo_s=slo_s, shed=shed)
+                               slo_s=slo_s, shed=shed, tenant=tenant)
         self.engines = {
             lb: ServingEngine(srv, buckets=self.buckets, label=lb,
                               injector=injector, **self._engine_kw)
@@ -269,7 +274,7 @@ class SchemeRouter:
         self.breakers = {
             lb: CircuitBreaker(failures=breaker_failures,
                                reset_s=breaker_reset_s,
-                               on_open=_opened, name=lb)
+                               on_open=_opened, name=lb, tenant=tenant)
             for lb in labels}
         self.supervisor = (EngineSupervisor(self) if supervise
                            else None)
@@ -505,6 +510,8 @@ class SchemeRouter:
                 # the arrival index FaultInjector events carry too —
                 # the join key for fault -> route attribution
                 ev["arrival"] = self.injector.arrival
+            if self.tenant is not None:
+                ev["tenant"] = self.tenant
             FLIGHT.record("route", **ev)
             return RouteDecision(label, routed_from, bucket, batch)
 
@@ -556,9 +563,11 @@ class SchemeRouter:
                            and decision.construction != last_label)
             if failed_over:
                 self.recovery.inc("failovers")
-                FLIGHT.record("failover", frm=last_label,
-                              to=decision.construction, batch=batch,
-                              attempt=attempt)
+                fev = dict(frm=last_label, to=decision.construction,
+                           batch=batch, attempt=attempt)
+                if self.tenant is not None:
+                    fev["tenant"] = self.tenant
+                FLIGHT.record("failover", **fev)
             last_label = decision.construction
             try:
                 if attempt == 1:
@@ -578,10 +587,12 @@ class SchemeRouter:
                         or attempt >= policy.max_attempts):
                     raise
                 self.recovery.inc("retries")
-                FLIGHT.record("retry",
-                              construction=decision.construction,
-                              batch=batch, attempt=attempt,
-                              error=type(e).__name__)
+                rev = dict(construction=decision.construction,
+                           batch=batch, attempt=attempt,
+                           error=type(e).__name__)
+                if self.tenant is not None:
+                    rev["tenant"] = self.tenant
+                FLIGHT.record("retry", **rev)
                 if isinstance(e, EngineDead):
                     # dead engines don't heal within a backoff window:
                     # fail over NOW, no sleep

@@ -310,7 +310,11 @@ class EngineCounters:
         the last engine merged.  Returns self, so
         ``reduce(EngineCounters.merge, stats_list, EngineCounters())``
         builds one aggregate record.  Locks both instances in id order
-        (no deadlock against a concurrent opposite-direction merge)."""
+        (no deadlock against a concurrent opposite-direction merge).
+        Merging an instance into itself is a no-op (it would silently
+        double every counter and duplicate the pooled latency ring)."""
+        if other is self:
+            return self
         first, second = ((self, other) if id(self) <= id(other)
                          else (other, self))
         with first._lock, second._lock:

@@ -544,3 +544,32 @@ def test_chaos_bench_dryrun_record():
         cl["faults"]["corruptions_injected"]
     assert cl["victim_breaker_transitions"][-1] == "closed"
     json.dumps(rec)                         # record is committable JSON
+
+
+# --------------------------------------------------- plan serialization
+
+def test_fault_plan_dict_round_trip_restores_defaults_and_stream():
+    plan = FaultPlan([
+        FaultSpec("dispatch_error", p=0.4, construction="logn",
+                  start=1, stop=9),
+        FaultSpec("latency", p=0.5, latency_s=0.001, bucket=8),
+        FaultSpec("engine_death", construction="radix4", start=5),
+    ], seed=2718)
+    wire = json.loads(json.dumps(plan.as_dict()))  # exactly what a
+    clone = FaultPlan.from_dict(wire)              # bench record holds
+    assert clone.seed == plan.seed
+    assert clone.specs == plan.specs
+    # as_dict drops None'd fields; from_dict restores the defaults
+    assert "bucket" not in wire["specs"][0]
+    assert clone.specs[0].bucket is None
+    assert clone.specs[2].stop is None
+    # unknown keys (a future record format) are ignored, not fatal
+    wire["specs"][0]["someday"] = True
+    assert FaultPlan.from_dict(wire).specs == plan.specs
+    # the seeded decision stream survives the round trip
+    a, b = plan.injector(), clone.injector()
+    for arrival in range(12):
+        a.begin_arrival(arrival)
+        b.begin_arrival(arrival)
+        for i, spec in enumerate(plan.specs):
+            assert a._decide(i, spec) == b._decide(i, clone.specs[i])

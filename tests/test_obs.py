@@ -331,3 +331,44 @@ def test_engine_emits_spans_and_registers_metrics():
     assert all(e["parent_id"] is not None for e in packs)
     # the engine self-registered: its series are scrapeable
     assert "dpf_engine_batches_submitted_total" in REGISTRY.openmetrics()
+
+
+# ----------------------------------------------------- ring capacity knobs
+
+def test_flight_ring_env_knob_and_drop_accounting(monkeypatch):
+    from dpf_tpu.obs import flight as flight_mod
+    monkeypatch.setenv("DPF_FLIGHT_RING", "4")
+    fr = FlightRecorder()
+    assert fr.capacity == 4
+    for i in range(6):
+        fr.record("x", i=i)
+    assert fr.recorded == 6 and fr.dropped == 2
+    assert [e["i"] for e in fr.dump()] == [2, 3, 4, 5]
+    # an explicit capacity beats the env knob; garbage falls back to
+    # the default
+    assert FlightRecorder(capacity=7).capacity == 7
+    monkeypatch.setenv("DPF_FLIGHT_RING", "not-a-number")
+    assert FlightRecorder().capacity == flight_mod.FLIGHT_RING
+
+
+def test_span_ring_env_knob(monkeypatch):
+    monkeypatch.setenv("DPF_SPAN_RING", "16")
+    assert Tracer()._ring.maxlen == 16
+    assert Tracer(capacity=5)._ring.maxlen == 5
+    t = obs_tracer.enable()
+    try:
+        assert t._ring.maxlen == 16
+    finally:
+        obs_tracer.disable()
+    monkeypatch.delenv("DPF_SPAN_RING")
+    assert Tracer()._ring.maxlen == obs_tracer.SPAN_RING
+
+
+def test_flight_dropped_metric_exported():
+    # the process collector (global REGISTRY) exports the global
+    # flight recorder's drop counter; the drop path itself is covered
+    # by test_flight_ring_env_knob_and_drop_accounting
+    from dpf_tpu.obs.metrics import REGISTRY
+    snap = REGISTRY.snapshot()
+    assert snap["dpf_flight_events_dropped"]["kind"] == "counter"
+    assert "dpf_flight_events_dropped_total" in REGISTRY.openmetrics()

@@ -311,3 +311,71 @@ def test_cache_counters_reset():
     assert c.reset() is c
     assert c == CacheCounters()
     assert c.as_dict()["compile_time_saved_s"] == 0.0
+
+
+def test_engine_counters_self_merge_is_noop():
+    from dpf_tpu.utils.profiling import EngineCounters
+    c = EngineCounters()
+    c.inc("retries", 3)
+    c.note_dispatch(padded=8, in_flight=2)
+    c.note_latency(0.01)
+    before = c.as_dict()
+    assert c.merge(c) is c
+    assert c.as_dict() == before
+
+
+def test_engine_counters_threaded_reset_merge_stress():
+    import threading
+
+    from dpf_tpu.utils.profiling import EngineCounters
+    workers = [EngineCounters() for _ in range(4)]
+    agg = EngineCounters()
+    errors = []
+    per = 1500
+
+    def write(c):
+        try:
+            for _ in range(per):
+                c.inc("retries")
+                c.note_dispatch(padded=4, in_flight=1)
+                c.note_latency(1e-4)
+        except Exception as e:  # pragma: no cover - the assert below
+            errors.append(e)
+
+    def scrape():
+        try:
+            for _ in range(300):
+                snap = EngineCounters()
+                for c in workers:
+                    snap.merge(c)
+                agg.merge(snap)
+                agg.as_dict()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def wipe():
+        try:
+            for _ in range(200):
+                agg.reset()
+                agg.as_dict()
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=write, args=(c,))
+               for c in workers]
+    threads += [threading.Thread(target=scrape),
+                threading.Thread(target=wipe)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert errors == []
+    # merge/reset of the aggregate never mutated the sources: the
+    # quiesced per-worker totals are exact
+    final = EngineCounters()
+    for c in workers:
+        final.merge(c)
+    d = final.as_dict()
+    assert d["retries"] == 4 * per
+    assert d["dispatches"] == 4 * per
+    assert d["padded_queries"] == 4 * per * 4

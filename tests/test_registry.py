@@ -1,0 +1,192 @@
+"""Table-registry residency tests (serve/registry.py): versioned
+registration, byte-budget accounting across versions, LRU eviction
+order under interleaved tenants, pinned versions surviving eviction
+pressure (in-flight queries complete against the pinned upload),
+bit-identical re-promotion after demotion, and the flight/metrics
+export of every residency transition."""
+
+import numpy as np
+import pytest
+
+from dpf_tpu.obs.flight import FLIGHT
+from dpf_tpu.serve.registry import TableRegistry
+
+N, ENTRY = 256, 4
+
+
+def _table(n=N, entry=ENTRY, seed=7):
+    return np.random.default_rng(seed).integers(
+        0, 2 ** 31, (n, entry), dtype=np.int32)
+
+
+def _reg(**kw):
+    # single construction: the residency machinery is identical and
+    # the test skips two compile stacks per version
+    kw.setdefault("labels", ("logn",))
+    return TableRegistry(**kw)
+
+
+def _one(labels=1, n=N, entry=ENTRY):
+    """Post-padding device bytes of one registered version."""
+    return n * entry * 4 * labels
+
+
+def _row(reg, name, version=None):
+    rows = [r for r in reg.stats()["tables"] if r["name"] == name
+            and (version is None or r["version"] == version)]
+    assert len(rows) == 1, rows
+    return rows[0]
+
+
+def _keys(srv, count=4, tag=b"reg"):
+    return [srv.gen((i * 31) % N, N, seed=tag + b"-%d" % i)[0]
+            for i in range(count)]
+
+
+# -------------------------------------------------- budget accounting
+
+def test_byte_budget_accounting_across_versions():
+    one = _one()
+    reg = _reg(budget_bytes=2 * one)
+    reg.register("t", _table(seed=1))
+    reg.register("t", _table(seed=2))
+    assert reg.resident_bytes == 2 * one
+    assert all(r["bytes"] == one for r in reg.stats()["tables"])
+    # a third version must evict the LRU version, not blow the budget
+    reg.register("t", _table(seed=3))
+    assert reg.resident_bytes == 2 * one
+    resident = {r["version"]: r["resident"]
+                for r in reg.stats()["tables"]}
+    assert resident == {1: False, 2: True, 3: True}
+    assert reg.counters["evictions"] == 1
+    assert reg.counters["demotions"] == 1
+    assert reg.counters["registrations"] == 3
+
+
+def test_register_rejects_duplicate_version_and_unknown_lookups():
+    reg = _reg()
+    reg.register("t", _table(), version=3)
+    with pytest.raises(ValueError):
+        reg.register("t", _table(), version=3)
+    # monotonic continuation past an explicit version
+    assert reg.register("t", _table(seed=2)).version == 4
+    with pytest.raises(KeyError):
+        reg.acquire("nope")
+    with pytest.raises(KeyError):
+        reg.acquire("t", version=99)
+
+
+# ------------------------------------------------------- LRU ordering
+
+def test_lru_order_under_interleaved_tenants():
+    one = _one()
+    reg = _reg(budget_bytes=2 * one)
+    reg.register("a", _table(seed=1))
+    reg.register("b", _table(seed=2))
+    # interleaved touches: a is hotter than b when pressure arrives
+    reg.acquire("b").release()
+    reg.acquire("a").release()
+    reg.register("c", _table(seed=3))
+    resident = {r["name"]: r["resident"] for r in reg.stats()["tables"]}
+    assert resident == {"a": True, "b": False, "c": True}
+    # touching the demoted table re-promotes it and evicts the new LRU
+    reg.acquire("b").release()
+    resident = {r["name"]: r["resident"] for r in reg.stats()["tables"]}
+    assert resident == {"a": False, "b": True, "c": True}
+    assert reg.counters["evictions"] == 2
+    assert reg.counters["promotions"] == 1
+    assert reg.counters["misses"] == 1
+
+
+# ------------------------------------- pinned versions under pressure
+
+def test_pinned_version_survives_eviction_pressure():
+    one = _one()
+    reg = _reg(budget_bytes=one)
+    reg.register("hot", _table(seed=1))
+    with reg.acquire("hot") as lease:
+        srv = lease.server("logn")
+        keys = _keys(srv)
+        want = np.asarray(srv.eval_cpu(keys))
+        # budget pressure with every resident byte pinned: the registry
+        # overcommits rather than demote under an in-flight query
+        reg.register("cold", _table(seed=2))
+        assert reg.counters["overcommits"] == 1
+        assert _row(reg, "hot")["resident"]
+        # an explicit demotion of a pinned version only defers
+        assert reg.demote("hot") is False
+        assert reg.counters["deferred_demotions"] == 1
+        assert _row(reg, "hot")["demote_pending"]
+        # in-flight queries complete against the pinned device upload
+        got = np.asarray(srv.eval_tpu(keys))
+        assert np.array_equal(got, want)
+    # last release runs the deferred demotion
+    row = _row(reg, "hot")
+    assert not row["resident"] and not row["demote_pending"]
+    assert reg.counters["demotions"] == 1
+
+
+def test_nested_pins_defer_demotion_until_last_release():
+    reg = _reg()
+    reg.register("t", _table())
+    l1 = reg.acquire("t")
+    l2 = reg.acquire("t")
+    reg.demote("t")
+    l1.release()
+    l1.release()                      # idempotent
+    assert _row(reg, "t")["resident"]  # l2 still pins
+    l2.release()
+    assert not _row(reg, "t")["resident"]
+
+
+# ----------------------------------------------------- re-promotion
+
+def test_repromotion_after_demotion_is_bit_identical():
+    reg = _reg()
+    reg.register("t", _table(seed=5))
+    with reg.acquire("t") as lease:
+        srv = lease.server("logn")
+        keys = _keys(srv, count=6, tag=b"promo")
+        want = np.asarray(srv.eval_tpu(keys))
+        assert np.array_equal(want, np.asarray(srv.eval_cpu(keys)))
+    assert reg.counters["hits"] == 1
+    assert reg.demote("t") is True
+    with reg.acquire("t") as lease:   # miss -> promote (re-upload)
+        got = np.asarray(lease.server("logn").eval_tpu(keys))
+    assert np.array_equal(got, want)
+    assert reg.counters["misses"] == 1
+    assert reg.counters["promotions"] == 1
+
+
+# ---------------------------------------------------- observability
+
+def test_registry_flight_events_and_metrics_export():
+    FLIGHT.clear()
+    one = _one()
+    reg = _reg(budget_bytes=2 * one)
+    reg.register("m", _table(seed=1))
+    reg.register("m", _table(seed=2))
+    reg.register("m", _table(seed=3))          # evicts v1
+    reg.acquire("m", version=1).release()      # promotes v1, evicts v2
+    actions = [e["action"] for e in FLIGHT.dump()
+               if e.get("kind") == "registry"]
+    assert actions.count("register") == 3
+    assert actions.count("evict") == 2
+    assert actions.count("promote") == 1
+    # registry gauges/counters export into an isolated registry
+    from dpf_tpu.obs.metrics import (MetricsRegistry,
+                                     register_table_registry)
+    mr = MetricsRegistry()
+    register_table_registry(reg, registry=mr)
+    snap = mr.snapshot()
+    assert any(v == 2 * one
+               for v in snap["dpf_registry_budget_bytes"]
+               ["series"].values())
+    assert any(v == reg.resident_bytes
+               for v in snap["dpf_registry_resident_bytes"]
+               ["series"].values())
+    assert any(v == 2 for v in snap["dpf_registry_evictions"]
+               ["series"].values())
+    # per-version residency gauge carries table/version labels
+    labels = "".join(snap["dpf_registry_table_resident"]["series"])
+    assert 'table="m"' in labels
