@@ -16,6 +16,7 @@
 #   --trace            obs/bench_trace.py           BENCH_TRACE_r12.json
 #   --multihost        serve/bench_multihost.py     MULTIHOST_r14.json
 #   --multitenant      serve/bench_multitenant.py   MULTITENANT_r16.json
+#   --plan             plan/bench_plan.py           PLAN_r17.json
 #
 # --serve: streaming serving benchmark (blocking loop vs pipelined
 # ServingEngine).  See docs/SERVING.md.
@@ -82,6 +83,16 @@
 # within 1.5x of its solo baseline while the victim degrades, every
 # served batch gated against the scalar oracle; --dryrun is the
 # seconds-long CI smoke.  See docs/MULTITENANT.md.
+#
+# --plan: capacity planning — the digital twin of the serve stack
+# (dpf_tpu/plan/: seeded discrete-event simulator over the router's
+# serializable cost table, zero JAX dispatches) gated for p99/shed-rate
+# fidelity against the real open-loop harness on identical seeded
+# traces, plus the headroom planner (monotone-in-load fleet sizing) and
+# the autoscaler evaluated in the twin (two diurnal days + one engine
+# death vs the static peak fleet on engine-hours) and against real
+# ServingEngine replicas; --dryrun is the seconds-long CI smoke.  See
+# docs/PLANNING.md.
 #
 # --trace: end-to-end observability — span tracing over the serving
 # path with a joint host+device digest for one tuned shape, the
@@ -217,6 +228,10 @@ if __name__ == "__main__":
     if "--multitenant" in sys.argv:
         from dpf_tpu.serve.bench_multitenant import main
         main([a for a in sys.argv[1:] if a != "--multitenant"])
+        sys.exit(0)
+    if "--plan" in sys.argv:
+        from dpf_tpu.plan.bench_plan import main
+        main([a for a in sys.argv[1:] if a != "--plan"])
         sys.exit(0)
     if "--trace" in sys.argv:
         from dpf_tpu.obs.bench_trace import main

@@ -599,6 +599,34 @@ def register_tenants(tenant_router,
     reg.watch(tenant_router, emit)
 
 
+def register_planner(stats, registry: MetricsRegistry | None = None):
+    """Export the planning tier's counters (``plan/twin.PLAN_STATS``,
+    or any object with the same attribute surface) as ``dpf_plan_*``
+    series (weakly held — the plan package owns the singleton, so the
+    weakref stays live for the process lifetime).  The plan package is
+    deliberately jax-free and never imports obs; the BENCH/planner
+    process calls this after importing both sides."""
+    reg = registry or REGISTRY
+
+    def emit(s):
+        out = []
+        for f in ("twin_runs", "sim_arrivals", "sim_sheds", "sweeps",
+                  "scale_ups", "scale_downs"):
+            out.append(("dpf_plan_" + f, "counter",
+                        "PlannerStats." + f, {},
+                        float(getattr(s, f))))
+        if s.last_p99_ms is not None:
+            out.append(("dpf_plan_last_p99_ms", "gauge",
+                        "p99 of the most recent twin run", {},
+                        float(s.last_p99_ms)))
+        if s.last_replicas is not None:
+            out.append(("dpf_plan_last_replicas", "gauge",
+                        "alive replicas at the end of the most recent "
+                        "twin run", {}, float(s.last_replicas)))
+        return [(n, k, h, _with_process(l), v) for n, k, h, l, v in out]
+    reg.watch(stats, emit)
+
+
 def _process_samples():
     """CacheCounters + SWALLOWED_ERRORS + tracer/flight meta — the
     process-wide series, registered once at import."""

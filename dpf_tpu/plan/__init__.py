@@ -1,0 +1,27 @@
+"""Capacity planning: the digital twin, the fleet planner, autoscaling.
+
+``dpf_tpu.plan`` answers fleet-sizing questions without standing a
+fleet up: a seeded discrete-event twin of the serve stack
+(``twin.py``), a replica-sweep capacity planner (``capacity.py``), and
+a reactive autoscale policy evaluated in the twin AND runnable against
+real engines (``autoscale.py``).  ``bench_plan.py`` is the
+``benchmark.py --plan`` entry whose headline gate is twin fidelity
+against the real open-loop harness; docs/PLANNING.md is the guide.
+
+The pure core (twin/capacity/autoscale) imports only stdlib+numpy —
+no jax, no other dpf_tpu packages — so a twin run is reproducible with
+zero JAX dispatches (tests/test_plan.py asserts this by importing the
+modules in a jax-free subprocess).  Import them via this package in
+normal code; the subprocess trick exists only to PROVE the property.
+"""
+
+from .autoscale import AutoscalePolicy, ReplicaPool
+from .capacity import plan_fleet, required_replicas
+from .twin import (CostTable, FaultMirror, FleetConfig, PLAN_STATS,
+                   TwinResult, simulate)
+
+__all__ = [
+    "AutoscalePolicy", "CostTable", "FaultMirror", "FleetConfig",
+    "PLAN_STATS", "ReplicaPool", "TwinResult", "plan_fleet",
+    "required_replicas", "simulate",
+]

@@ -1,0 +1,152 @@
+"""Fleet capacity planner: minimal engines/hosts meeting an SLO.
+
+Given a trace, an SLO, and a fingerprint's cost table
+(``SchemeRouter.cost_table()`` live, or ``tune.serve_tune.
+cached_cost_table`` from the tuning cache), sweep replica counts
+through the digital twin (``plan/twin.py``) and report the smallest
+fleet that holds p99 under the SLO with an acceptable shed rate —
+plus headroom curves (required replicas at scaled offered loads, via
+``loadgen.scale_rate``-style time compression applied here to keep the
+module jax-free).
+
+Planner invariants (gated in the ``--plan`` record):
+
+* **monotone in offered load** — more qps never plans fewer engines.
+  The sweep enforces this by construction (a running max over
+  ascending load scales), so a non-monotone twin artifact can never
+  leak into a sizing decision.
+* hosts = ceil(engines / host_slots) (``FleetConfig.hosts``).
+
+Pure stdlib+numpy, like the twin: the planner runs with zero JAX
+dispatches.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from .twin import CostTable, FleetConfig, PLAN_STATS, simulate
+
+
+def _scale_trace(trace, factor: float) -> list:
+    """Compress arrival times by ``factor`` (> 1 = hotter), keeping
+    batches — the twin-side equivalent of ``loadgen.scale_rate``
+    (kept here, duplicated in spirit, so the planner never imports the
+    jax-adjacent serve package)."""
+    if factor <= 0:
+        raise ValueError("factor must be > 0 (got %r)" % (factor,))
+    out = []
+    for a in trace:
+        if hasattr(a, "t"):
+            out.append((float(a.t) / factor, int(a.batch)))
+        elif isinstance(a, dict):
+            out.append((float(a["t"]) / factor, int(a["batch"])))
+        else:
+            t, b = a
+            out.append((float(t) / factor, int(b)))
+    return out
+
+
+@dataclasses.dataclass
+class PlanResult:
+    """One planned point: the minimal passing fleet and its twin run."""
+    replicas: int
+    hosts: int
+    met_slo: bool
+    summary: dict
+
+    def as_dict(self) -> dict:
+        return {"replicas": self.replicas, "hosts": self.hosts,
+                "met_slo": self.met_slo, "summary": self.summary}
+
+
+def required_replicas(trace, cost_table, *, label: str, slo_s: float,
+                      fleet_kw: dict | None = None, seed: int = 0,
+                      max_replicas: int = 16,
+                      max_shed_rate: float = 0.0,
+                      dispatch_blocking: bool = False) -> PlanResult:
+    """Smallest replica count of ``label`` whose twin run meets the
+    SLO (p99 <= slo_s and shed_rate <= max_shed_rate and no failed
+    arrivals) on ``trace``.
+
+    Sweeps 1..max_replicas ascending and stops at the first pass; when
+    nothing passes, returns the ``max_replicas`` run with
+    ``met_slo=False`` (the caller sees the planner saturated rather
+    than a silent cap).  Uses the fleet (async-dispatch) twin model by
+    default — replicas must overlap to matter.
+    """
+    if isinstance(cost_table, dict):
+        cost_table = CostTable.from_dict(cost_table)
+    fleet_kw = dict(fleet_kw or {})
+    fleet_kw.setdefault("slo_s", slo_s)
+    last = None
+    for r in range(1, max_replicas + 1):
+        fleet = FleetConfig(replicas={label: r},
+                            dispatch_blocking=dispatch_blocking,
+                            **fleet_kw)
+        res = simulate(trace, cost_table, fleet, seed=seed,
+                       record_events=False)
+        PLAN_STATS.sweeps += 1
+        s = res.summary()
+        p99 = s["p99_ms"]
+        ok = (p99 is not None and p99 <= slo_s * 1e3
+              and s["shed_rate"] <= max_shed_rate
+              and s["failed"] == 0)
+        last = PlanResult(replicas=r, hosts=fleet.hosts(),
+                          met_slo=ok, summary=s)
+        if ok:
+            return last
+    return last
+
+
+def plan_fleet(trace, cost_table, *, label: str, slo_s: float,
+               load_scales=(0.5, 1.0, 1.5, 2.0), seed: int = 0,
+               fleet_kw: dict | None = None, max_replicas: int = 16,
+               max_shed_rate: float = 0.0,
+               host_slots: int = 4) -> dict:
+    """The capacity plan: minimal fleet at the offered load plus the
+    headroom curve over ``load_scales``.
+
+    Monotonicity is enforced by construction: replicas at each scale
+    are the running max over ascending scales, so "more qps never
+    plans fewer engines" holds for every emitted plan — any twin
+    noise that would dip the curve is absorbed upward (conservative:
+    over-provisioning, never under)."""
+    if isinstance(cost_table, dict):
+        cost_table = CostTable.from_dict(cost_table)
+    fleet_kw = dict(fleet_kw or {})
+    fleet_kw.setdefault("host_slots", host_slots)
+    scales = sorted(set(float(s) for s in load_scales) | {1.0})
+    curve = []
+    running = 0
+    for sc in scales:
+        scaled = _scale_trace(trace, sc)
+        pr = required_replicas(
+            scaled, cost_table, label=label, slo_s=slo_s,
+            fleet_kw=fleet_kw, seed=seed, max_replicas=max_replicas,
+            max_shed_rate=max_shed_rate)
+        planned = max(running, pr.replicas)
+        running = planned
+        curve.append({
+            "load_scale": sc,
+            "replicas": planned,
+            "replicas_raw": pr.replicas,
+            "hosts": -(-planned // int(fleet_kw["host_slots"])),
+            "met_slo": pr.met_slo,
+            "p99_ms": pr.summary["p99_ms"],
+            "shed_rate": pr.summary["shed_rate"],
+            "qps": pr.summary["qps"],
+        })
+    at_one = next(c for c in curve if c["load_scale"] == 1.0)
+    monotone = all(curve[i]["replicas"] <= curve[i + 1]["replicas"]
+                   for i in range(len(curve) - 1))
+    return {
+        "construction": label,
+        "slo_ms": round(slo_s * 1e3, 3),
+        "replicas": at_one["replicas"],
+        "hosts": at_one["hosts"],
+        "met_slo": at_one["met_slo"],
+        "headroom_curve": curve,
+        "monotone": monotone,   # True by construction; recorded so the
+        #                         gate can assert it from the record
+    }

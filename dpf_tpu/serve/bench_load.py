@@ -272,6 +272,10 @@ def load_bench(n=4096, entry_size=16, cap=128, prf=0, *,
                   "cap": cap, "reps": reps, "window": window},
         "sticky": sticky_leg,
         "router": router_leg,
+        # the live EWMA cost model after the race — the digital twin's
+        # service-time input (plan/twin.py); embedding it makes every
+        # downstream twin run auditable against this record
+        "cost_table": router.cost_table(),
         "gate_rejections": rejections,
         "checked": rejections == 0,  # every served batch matched the
         #                              scalar oracle (DPF.eval_cpu)

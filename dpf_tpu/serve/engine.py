@@ -55,6 +55,16 @@ class LoadShed(RuntimeError):
     letting the queue grow past the SLO."""
 
 
+class EngineClosed(RuntimeError):
+    """The engine was decommissioned (``ServingEngine.close``): every
+    subsequent ``submit`` is rejected cleanly.  Distinct from
+    ``LoadShed`` (an admission *decision* that self-heals) — a closed
+    engine never comes back; the caller must route elsewhere.  This is
+    the autoscaler's scale-down contract (``plan/autoscale.py``): a
+    retained handle that submits after the drain gets this instead of
+    racing the teardown."""
+
+
 class _Part:
     """One dispatched (bucket-padded) chunk of a submitted batch."""
     __slots__ = ("dev", "n_real", "bucket", "out")
@@ -174,6 +184,7 @@ class ServingEngine:
         self.tenant = tenant      # owning tenant (metrics/flight labels)
         self._injector = injector
         self.stats = EngineCounters()
+        self._closed = False      # set by close(); submit rejects after
         self._queue = deque()     # _Part refs, dispatch order, unresolved
         self._pending = deque()   # futures with unresolved parts, FIFO
         # Persistent XLA compilation cache, on by default for the serve
@@ -208,6 +219,10 @@ class ServingEngine:
         ``shed=True`` — is rejected with ``LoadShed`` before any decode
         or dispatch work happens.
         """
+        if self._closed:
+            raise EngineClosed(
+                "engine %r is closed — submit after close()"
+                % (self.label or "engine",))
         self._check_deadline()
         t_enter = time.perf_counter()
         # pre-decoded packed batches (LookupStream) carry .batch
@@ -320,6 +335,27 @@ class ServingEngine:
             while any(p.out is None for p in head._parts):
                 self._resolve_one()
             self._finalize(head)
+
+    def close(self) -> None:
+        """Decommission: drain every outstanding dispatch, then reject
+        all future ``submit``s with ``EngineClosed``.  In-flight work
+        completes (every previously returned future resolves normally);
+        counters are left intact for the caller's final accounting.
+        Idempotent — the autoscaler's scale-down path
+        (``plan/autoscale.ReplicaPool.scale_down``) drains explicitly
+        first and then calls this for the rejection contract."""
+        self.drain()
+        if not self._closed:
+            self._closed = True
+            ev = dict(engine=self.label or "engine",
+                      served=self.stats.queries_submitted)
+            if self.tenant is not None:
+                ev["tenant"] = self.tenant
+            FLIGHT.record("engine_close", **ev)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
 
     # ------------------------------------------------------------- warmup
 

@@ -79,6 +79,38 @@ def squeeze(trace, factor: float) -> list:
     return [Arrival(a.t / factor, a.n, a.batch) for a in trace]
 
 
+def scale_rate(trace, factor: float) -> list:
+    """Alias of ``squeeze`` under the capacity planner's vocabulary:
+    scale the OFFERED LOAD by ``factor`` (> 1 = hotter) by compressing
+    arrival times, batches untouched.  The planner's headroom curves
+    (``plan/capacity.plan_fleet``) sweep exactly this knob, so the
+    name states the planning question ("what if traffic were 1.5x?")
+    rather than the mechanism."""
+    return squeeze(trace, factor)
+
+
+def concat_traces(*traces, gap_s: float = 0.0) -> list:
+    """Concatenate traces in time: each trace's arrivals are shifted
+    to start ``gap_s`` seconds after the previous trace's LAST arrival
+    (gap measured last-arrival -> first-arrival; an empty segment adds
+    nothing).  Deterministic composition of deterministic pieces —
+    ``concat_traces(day, day)`` is the two-day diurnal input the
+    capacity planner sweeps, same seed, same composed trace on every
+    machine.  Like ``squeeze``/``scale_rate``, the batch mix is
+    untouched: only the timeline changes."""
+    if gap_s < 0:
+        raise ValueError("gap_s must be >= 0 (got %r)" % (gap_s,))
+    out = []
+    offset = 0.0
+    for tr in traces:
+        if not tr:
+            continue
+        base = offset - tr[0].t
+        out.extend(Arrival(base + a.t, a.n, a.batch) for a in tr)
+        offset = out[-1].t + gap_s
+    return out
+
+
 def _draw_batch(rng, lo: int, hi: int) -> int:
     """Log-uniform batch size in [lo, hi]: small batches must be common
     enough to exercise the lower ladder rungs, big ones common enough

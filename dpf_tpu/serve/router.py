@@ -42,7 +42,7 @@ from ..obs.flight import FLIGHT
 from ..obs.tracer import span
 from ..utils.profiling import EngineCounters, note_swallowed
 from .buckets import Buckets
-from .engine import LoadShed, ServingEngine
+from .engine import EngineClosed, LoadShed, ServingEngine
 from .faults import (CircuitBreaker, EngineDead, EngineSupervisor,
                      RetryPolicy)
 
@@ -143,7 +143,7 @@ class RoutedFuture:
     def result(self):
         try:
             out = self._fut.result()
-        except (LoadShed, DeadlineExceeded):
+        except (LoadShed, DeadlineExceeded, EngineClosed):
             raise               # admission decisions, not engine faults
         except Exception as e:
             self._router._note_failure(self.decision.construction, e)
@@ -351,6 +351,41 @@ class SchemeRouter:
         """Current per-dispatch estimate (seconds), None when unknown."""
         return self._costs.get((label, bucket))
 
+    def cost_table(self) -> dict:
+        """The live EWMA cost model as a plain serializable dict:
+        ``{"construction@bucket": seconds}`` — the same key spelling
+        ``stats()["cost_model_ms"]`` uses (values here stay in SECONDS,
+        un-rounded: this is the machine-readable export).  This is the
+        digital twin's service-time input (``plan/twin.CostTable``);
+        ``--load`` and ``--plan`` records embed the snapshot so every
+        twin run's inputs are auditable against the router that
+        produced them."""
+        return {"%s@%d" % (lb, bk): s
+                for (lb, bk), s in sorted(self._costs.items())}
+
+    def seed_costs(self, table: dict) -> int:
+        """Re-seed the cost model from a ``cost_table()``-shaped dict
+        (string ``"label@bucket"`` or tuple ``(label, bucket)`` keys).
+        Entries for constructions this router does not serve are
+        skipped; returns the number of entries applied.  Seeded values
+        land exactly like probe observations — the EWMA updates from
+        live traffic afterwards, so a stale snapshot self-corrects at
+        the same rate a poisoned probe would."""
+        applied = 0
+        for key, s in dict(table).items():
+            if isinstance(key, str):
+                if key == "overhead_s":   # twin CostTable extra field
+                    continue
+                lb, bk = key.rsplit("@", 1)
+                key = (lb, int(bk))
+            lb, bk = str(key[0]), int(key[1])
+            if lb not in self.constructions:
+                continue
+            self._costs[(lb, bk)] = float(s)
+            self._obs_age[(lb, bk)] = 0
+            applied += 1
+        return applied
+
     # ----------------------------------------------------------- routing
 
     def _available(self, exclude=()) -> tuple:
@@ -528,7 +563,7 @@ class SchemeRouter:
         t0 = time.perf_counter()
         try:
             fut = engine.submit(keys)
-        except (LoadShed, DeadlineExceeded):
+        except (LoadShed, DeadlineExceeded, EngineClosed):
             raise               # admission decisions, not engine faults
         except Exception as e:
             self._note_failure(decision.construction, e)
@@ -580,7 +615,7 @@ class SchemeRouter:
                           construction=decision.construction):
                     return self.submit(decision,
                                        keys_for(decision.construction))
-            except (LoadShed, DeadlineExceeded):
+            except (LoadShed, DeadlineExceeded, EngineClosed):
                 raise
             except Exception as e:
                 if (not policy.retryable(e)
@@ -624,6 +659,18 @@ class SchemeRouter:
         """Resolve every outstanding dispatch across all engines."""
         for engine in self.engines.values():
             engine.drain()
+
+    def close(self) -> None:
+        """Drain, then decommission every engine: in-flight work
+        completes, and any later ``submit`` is rejected with the
+        engine's ``EngineClosed`` (passed through untouched — a closed
+        engine is a decision, not a fault, so it never counts against
+        a breaker).  Outstanding supervisor rebuilds are joined first
+        so a rebuilt engine cannot resurrect a closed construction."""
+        if self.supervisor is not None:
+            self.supervisor.join()
+        for engine in self.engines.values():
+            engine.close()
 
     def reset_counters(self) -> None:
         """Zero routing counts and every engine's counters (bench reps

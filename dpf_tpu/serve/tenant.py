@@ -52,7 +52,7 @@ from collections import deque
 from ..obs.flight import FLIGHT
 from ..utils.profiling import note_swallowed
 from .buckets import Buckets
-from .engine import LoadShed
+from .engine import EngineClosed, LoadShed
 from .registry import TableRegistry
 from .router import LABELS, SchemeRouter
 
@@ -239,6 +239,7 @@ class TenantRouter:
         self.quantum = float(quantum)
         self.tenants = {}             # name -> _Tenant
         self._ladders = {}            # (n, e, cap) -> (Buckets, knobs)
+        self._closed = False          # close() ran; submit rejects
         self._lock = threading.RLock()
         try:
             from ..obs.metrics import register_tenants
@@ -328,6 +329,9 @@ class TenantRouter:
         queue state.  Engine-level sheds/faults during the eventual
         dispatch surface on the returned future's ``result()``."""
         with self._lock:
+            if self._closed:
+                raise EngineClosed(
+                    "TenantRouter is closed — submit after close()")
             t = self.tenants[name]
             depth = len(t.queue) + t.in_flight
             if (t.spec.shed and t.spec.max_queue_depth is not None
@@ -496,8 +500,13 @@ class TenantRouter:
 
     def close(self) -> None:
         """Stop the per-tenant dispatch workers (outstanding grants are
-        drained first).  The router is not usable afterwards."""
+        drained first).  Not usable afterwards: a later ``submit``
+        raises ``EngineClosed`` (the same clean post-drain rejection
+        the engines give, ``serve/engine.py``) instead of deadlocking
+        against the stopped workers.  Idempotent."""
         self.drain()
+        with self._lock:
+            self._closed = True
         for t in self.tenants.values():
             with t.cv:
                 t.stopped = True

@@ -276,6 +276,34 @@ def lookup_router_knobs(router, cap: int,
         return None
 
 
+def cached_cost_table(*, n: int, entry_size: int, cap: int,
+                      prf_method: int = 0,
+                      cache: TuningCache | None = None) -> dict:
+    """Cache-backed cost seeding for the digital twin: recover a
+    ``{"construction@cap": seconds}`` table (the
+    ``SchemeRouter.cost_table()`` spelling) from an EXACT cap-batch
+    scheme-sweep entry's per-construction measured seconds — the same
+    rows ``SchemeRouter._resolve_sticky`` seeds its EWMA from.  Lets a
+    planner (``plan/capacity.py``) size a fleet for a fingerprint
+    that has been tuned on this machine WITHOUT standing a router up.
+    Never raises; empty dict on a cold cache."""
+    from .search import scheme_cache_key
+    out = {}
+    try:
+        cache = cache if cache is not None else default_cache()
+        rec = cache.lookup(scheme_cache_key(
+            n=int(n), entry_size=int(entry_size), batch=int(cap),
+            prf_method=int(prf_method)))
+        for row in (rec or {}).get("measured", {}).get(
+                "per_construction", ()):
+            lb, s = row.get("construction"), row.get("tuned_s")
+            if lb and s:
+                out["%s@%d" % (lb, int(cap))] = float(s)
+    except Exception:   # cache must never break planning
+        return {}
+    return out
+
+
 def tune_router(table, *, prf_method: int = 0, cap: int | None = None,
                 trace=None, trace_kind: str | None = None,
                 trace_kw: dict | None = None, in_flight=(1, 2),

@@ -88,3 +88,39 @@ def test_default_trace_per_kind():
         assert tr and all(1 <= a.batch <= 32 for a in tr)
     with pytest.raises(ValueError):
         loadgen.default_trace("replay", 32)
+
+
+def test_scale_rate_is_squeeze_under_planner_vocabulary():
+    tr = loadgen.poisson_trace(rate=20.0, arrivals=30, cap=32, seed=4)
+    assert loadgen.scale_rate(tr, 2.0) == loadgen.squeeze(tr, 2.0)
+    hot = loadgen.scale_rate(tr, 4.0)
+    assert [a.batch for a in hot] == [a.batch for a in tr]  # mix kept
+    assert all(h.t == pytest.approx(a.t / 4.0)
+               for h, a in zip(hot, tr))
+    with pytest.raises(ValueError):
+        loadgen.scale_rate(tr, 0.0)
+
+
+def test_concat_traces_deterministic_composition():
+    day = loadgen.diurnal_trace(base_rate=3.0, peak_rate=30.0,
+                                period_s=2.0, duration_s=4.0, cap=64,
+                                seed=5)
+    two = loadgen.concat_traces(day, day)
+    assert two == loadgen.concat_traces(day, day)  # deterministic
+    assert len(two) == 2 * len(day)
+    ts = [a.t for a in two]
+    assert ts == sorted(ts)
+    assert [a.batch for a in two] == 2 * [a.batch for a in day]
+    # each segment is re-based to start right at the previous
+    # segment's last arrival (the first segment starts at t=0)
+    assert two[0].t == 0.0
+    assert two[len(day)].t == pytest.approx(two[len(day) - 1].t)
+    # gap_s shifts the second segment by exactly the gap
+    gapped = loadgen.concat_traces(day, day, gap_s=1.5)
+    assert gapped[len(day)].t == pytest.approx(two[len(day)].t + 1.5)
+    # empty segments add nothing; negative gaps are rejected
+    assert loadgen.concat_traces([], day, []) == \
+        loadgen.concat_traces(day)
+    assert loadgen.concat_traces() == []
+    with pytest.raises(ValueError):
+        loadgen.concat_traces(day, day, gap_s=-0.1)

@@ -1,0 +1,406 @@
+"""Digital-twin / capacity-planner tests (dpf_tpu/plan/).
+
+Two test families:
+
+* **pure-core** — the twin is a pure function of (seed, trace,
+  cost_table, fleet): bit-reproducibility of the event log, the
+  zero-JAX import guarantee (asserted in a subprocess that loads the
+  plan modules WITHOUT the dpf_tpu package root, so jax can never
+  sneak in), and the parity of every mirrored formula against its real
+  counterpart (bucket math vs ``serve.Buckets``, fault decisions vs
+  ``faults.FaultInjector``, the nearest-rank quantile vs
+  ``utils.profiling.quantile``).
+* **bridge** — the pieces that touch real serving objects: the
+  router's cost-table export/seed round-trip, the drain/close paths
+  (``ServingEngine``, ``SchemeRouter``, ``TenantRouter``) the
+  autoscaler's scale-down relies on, and the real-engine
+  ``ReplicaPool`` up/down cycle.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from dpf_tpu.plan.autoscale import AutoscalePolicy
+from dpf_tpu.plan.capacity import plan_fleet, required_replicas
+from dpf_tpu.plan.twin import (CostTable, FaultMirror, FleetConfig,
+                               simulate)
+from dpf_tpu.plan import twin as twin_mod
+
+#: a synthetic cost table: logn cheap at small buckets, sqrtn cheap at
+#: the cap — enough structure for routing/planning to be non-trivial
+COSTS = {"logn@4": 0.002, "logn@8": 0.003, "logn@16": 0.006,
+         "sqrtn@4": 0.004, "sqrtn@8": 0.004, "sqrtn@16": 0.004}
+
+#: a short mixed trace (t, batch) — bursts of cap-size batches with
+#: idle gaps, enough to exercise chunking, backlog, and recovery
+TRACE = ([(0.005 * j, 16) for j in range(20)]
+         + [(0.4 + 0.05 * j, 3) for j in range(8)]
+         + [(1.0 + 0.004 * j, 16) for j in range(20)])
+
+
+def _fleet(**kw):
+    kw.setdefault("replicas", {"logn": 1, "sqrtn": 1})
+    kw.setdefault("bucket_sizes", (4, 8, 16))
+    return FleetConfig(**kw)
+
+
+# ------------------------------------------------------------ pure core
+
+
+def test_twin_bit_reproducible():
+    """Same inputs -> identical event log and summary, including under
+    faults and autoscaling (the hard case: every random draw seeded)."""
+    plan = {"seed": 5, "specs": [
+        {"kind": "dispatch_error", "p": 0.3, "start": 2},
+        {"kind": "latency", "p": 0.5, "latency_s": 0.002},
+        {"kind": "engine_death", "start": 25, "p": 1.0}]}
+
+    def run():
+        fleet = _fleet(dispatch_blocking=False, slo_s=0.5,
+                       rebuild_s=0.2)
+        pol = AutoscalePolicy(decide_every_s=0.05, cooldown_s=0.1,
+                              max_replicas=4)
+        return simulate(TRACE, COSTS, fleet, seed=7, fault_plan=plan,
+                        autoscaler=pol)
+
+    a, b = run(), run()
+    assert a.events == b.events and a.events   # non-trivial log
+    assert a.summary() == b.summary()
+    assert a.summary()["faults_injected"]["engine_death"] == 1
+
+
+def test_twin_seed_changes_probabilistic_runs():
+    plan = {"seed": 1, "specs": [{"kind": "dispatch_error", "p": 0.4}]}
+    a = simulate(TRACE, COSTS, _fleet(), seed=0, fault_plan=plan)
+    plan2 = dict(plan, seed=2)
+    b = simulate(TRACE, COSTS, _fleet(), seed=0, fault_plan=plan2)
+    assert a.events != b.events
+
+
+def test_plan_package_is_jax_free():
+    """The twin/planner/autoscaler core must import (and simulate)
+    without jax.  The subprocess loads the plan directory as a
+    synthetic package so ``dpf_tpu/__init__`` (which imports jax) never
+    runs — proving the modules themselves are stdlib+numpy only."""
+    import dpf_tpu.plan as plan_pkg
+    prog = textwrap.dedent("""
+        import sys, types
+        pkg = types.ModuleType("planpkg")
+        pkg.__path__ = [%r]
+        sys.modules["planpkg"] = pkg
+        from planpkg.twin import FleetConfig, simulate
+        from planpkg.capacity import plan_fleet
+        from planpkg.autoscale import AutoscalePolicy
+        res = simulate([(0.0, 4), (0.01, 8)], {"logn@8": 0.001},
+                       FleetConfig(replicas={"logn": 1},
+                                   bucket_sizes=(8,)))
+        assert res.summary()["served"] == 2
+        banned = [m for m in sys.modules if m.split(".")[0] in
+                  ("jax", "jaxlib", "dpf_tpu")]
+        assert not banned, "jax-adjacent modules loaded: %%s" %% banned
+        print("OK")
+    """) % list(plan_pkg.__path__)[0]
+    out = subprocess.run([sys.executable, "-c", prog],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "OK" in out.stdout
+
+
+def test_fleet_bucket_math_matches_serve_buckets():
+    from dpf_tpu.serve import Buckets
+    for sizes in [(4, 8, 16), (2, 16), (1, 2, 4, 8)]:
+        fleet = _fleet(bucket_sizes=sizes)
+        bk = Buckets(sizes)
+        assert fleet.max_bucket == bk.max
+        for b in range(1, 4 * max(sizes) + 1):
+            if b <= bk.max:
+                assert fleet.bucket_for(b) == bk.bucket_for(b)
+            assert fleet.chunks(b) == bk.chunks(b)
+    with pytest.raises(ValueError):
+        _fleet(bucket_sizes=(3, 8))
+    with pytest.raises(ValueError):
+        _fleet(bucket_sizes=(8,)).bucket_for(9)
+
+
+def test_fault_mirror_matches_real_injector():
+    """The mirrored decision function must agree with FaultInjector
+    draw for draw across a grid of arrivals/consults — including the
+    repeated-consult independence and the death single-fire cap."""
+    from dpf_tpu.serve.faults import FaultPlan, FaultSpec
+    plan = FaultPlan(specs=[
+        FaultSpec(kind="dispatch_error", p=0.35),
+        FaultSpec(kind="latency", p=0.6, construction="logn",
+                  latency_s=0.01, max_fires=3),
+        FaultSpec(kind="engine_death", p=0.5, start=3),
+        FaultSpec(kind="host_drop", bucket=8, p=0.9, stop=9),
+    ], seed=42)
+    real = plan.injector()
+    mirror = FaultMirror(plan.as_dict())
+    for j in range(12):
+        real.begin_arrival(j)
+        mirror.begin_arrival(j)
+        for _consult in range(3):
+            for idx, spec in enumerate(plan.specs):
+                label, bucket = "logn", 8
+                r_fire = (spec.kind, False)
+                if (real._fires_left(idx, spec)
+                        and spec.matches(label, bucket, j)):
+                    r_fire = (spec.kind, real._decide(idx, spec))
+                m_spec = mirror.specs[idx]
+                m_fire = (spec.kind, False)
+                if (mirror._fires_left(idx, m_spec)
+                        and mirror._matches(m_spec, label, bucket)):
+                    m_fire = (spec.kind, mirror._decide(idx, m_spec))
+                assert r_fire == m_fire, (j, _consult, idx)
+    assert mirror.injected == {k: v for k, v in real.injected.items()
+                               if v}
+    assert mirror.injected.get("engine_death", 0) <= 1
+    assert mirror.injected.get("host_drop", 0) <= 1
+
+
+def test_twin_quantile_matches_profiling():
+    from dpf_tpu.utils import profiling
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 7, 100, 2048):
+        xs = list(rng.random(n))
+        for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+            assert twin_mod.quantile(xs, q) == profiling.quantile(xs, q)
+    assert twin_mod.LATENCY_RING == profiling.LATENCY_RING
+
+
+def test_cost_table_roundtrip_and_nearest_bucket():
+    ct = CostTable(COSTS, overhead_s=0.001)
+    assert ct.labels() == ("logn", "sqrtn")
+    assert ct.service_s("logn", 8) == 0.003
+    # unmeasured bucket: nearest measured, scaled linearly by size
+    assert ct.service_s("logn", 32) == pytest.approx(0.006 * 2)
+    assert ct.service_s("logn", 2) == pytest.approx(0.002 / 2)
+    back = CostTable.from_dict(ct.as_dict())
+    assert back.as_dict() == ct.as_dict()
+    assert back.overhead_s == 0.001
+    with pytest.raises(ValueError):
+        CostTable({})
+    with pytest.raises(KeyError):
+        ct.service_s("radix4", 8)
+
+
+def test_twin_sheds_and_admission_mirror():
+    """Armed admission control sheds under a hot trace; the plain fleet
+    absorbs the same trace as queueing latency instead."""
+    hot = [(0.0005 * j, 16) for j in range(200)]
+    plain = simulate(hot, COSTS, _fleet()).summary()
+    armed = simulate(hot, COSTS,
+                     _fleet(slo_s=0.01, max_queue_depth=4,
+                            shed=True)).summary()
+    assert plain["shed_batches"] == 0
+    assert armed["shed_batches"] > 0
+    assert armed["shed_rate"] == pytest.approx(
+        armed["shed_batches"] / armed["arrivals"])
+    assert armed["p99_ms"] < plain["p99_ms"]
+
+
+def test_planner_monotone_and_saturation():
+    pr1 = required_replicas(TRACE, COSTS, label="logn", slo_s=0.05,
+                            fleet_kw={"bucket_sizes": (4, 8, 16)})
+    assert pr1.met_slo and pr1.replicas >= 1
+    # an impossible SLO saturates at max_replicas, flagged not silent
+    sat = required_replicas(TRACE, COSTS, label="logn", slo_s=1e-6,
+                            fleet_kw={"bucket_sizes": (4, 8, 16)},
+                            max_replicas=3)
+    assert not sat.met_slo and sat.replicas == 3
+    plan = plan_fleet(TRACE, COSTS, label="logn", slo_s=0.02,
+                      load_scales=(0.5, 1.0, 2.0, 4.0),
+                      fleet_kw={"bucket_sizes": (4, 8, 16)})
+    curve = plan["headroom_curve"]
+    assert plan["monotone"]
+    assert all(curve[i]["replicas"] <= curve[i + 1]["replicas"]
+               for i in range(len(curve) - 1))
+    assert plan["hosts"] == -(-plan["replicas"] // 4)
+
+
+def test_autoscale_policy_decisions():
+    pol = AutoscalePolicy(decide_every_s=0.1, cooldown_s=0.0,
+                          min_replicas=1, max_replicas=3,
+                          ewma_alpha=1.0)
+    up = pol.decide(util=0.9, p99_s=None, slo_s=None, replicas=1,
+                    since_change_s=10)
+    assert up == "up"
+    # max bound is hard even under pressure
+    assert pol.decide(util=0.9, p99_s=None, slo_s=None, replicas=3,
+                      since_change_s=10) is None
+    # p99 near the SLO scales up even at modest utilization
+    assert pol.decide(util=0.4, p99_s=0.95, slo_s=1.0, replicas=1,
+                      since_change_s=10) == "up"
+    # quiet + cool p99 scales down, but never below min
+    assert pol.decide(util=0.05, p99_s=0.1, slo_s=1.0, replicas=2,
+                      since_change_s=10) == "down"
+    assert pol.decide(util=0.05, p99_s=0.1, slo_s=1.0, replicas=1,
+                      since_change_s=10) is None
+    # cooldown holds regardless of signals
+    cold = AutoscalePolicy(cooldown_s=5.0, ewma_alpha=1.0)
+    assert cold.decide(util=0.99, p99_s=None, slo_s=None, replicas=1,
+                       since_change_s=1.0) is None
+    with pytest.raises(ValueError):
+        AutoscalePolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        AutoscalePolicy(ewma_alpha=0.0)
+
+
+def test_twin_autoscaler_beats_static_on_engine_hours():
+    """The acceptance shape of the bench's autoscale leg, miniature:
+    a two-peak trace with an engine death; autoscaled engine-hours
+    strictly under the static 3-replica fleet, availability held."""
+    peak = [(0.002 * j, 16) for j in range(60)]
+    lull = [(0.5 + 0.05 * j, 2) for j in range(8)]
+    peak2 = [(1.2 + 0.002 * j, 16) for j in range(60)]
+    trace = peak + lull + peak2
+    plan = {"seed": 9, "specs": [{"kind": "engine_death", "start": 30,
+                                  "p": 1.0}]}
+    kw = dict(bucket_sizes=(4, 8, 16), dispatch_blocking=False,
+              slo_s=0.5, rebuild_s=0.1, spinup_s=0.01,
+              retry_max_attempts=4)
+    static = simulate(trace, COSTS, _fleet(replicas={"logn": 3}, **kw),
+                      seed=3, fault_plan=plan).summary()
+    pol = AutoscalePolicy(decide_every_s=0.02, cooldown_s=0.04,
+                          max_replicas=4)
+    auto = simulate(trace, COSTS, _fleet(replicas={"logn": 1}, **kw),
+                    seed=3, fault_plan=plan, autoscaler=pol).summary()
+    assert auto["autoscale"]["ups"] >= 1
+    assert auto["availability"] >= 0.99
+    assert auto["engine_hours"] < static["engine_hours"]
+    assert auto["faults_injected"]["engine_death"] == 1
+
+
+# ----------------------------------------------------- serving bridges
+
+
+N, ENTRY, CAP = 256, 4, 8
+
+
+def _router(**kw):
+    from dpf_tpu.serve.router import SchemeRouter
+    table = np.random.default_rng(17).integers(
+        0, 2 ** 31, (N, ENTRY), dtype=np.int32)
+    kw.setdefault("cap", CAP)
+    kw.setdefault("warmup", False)
+    kw.setdefault("probe", False)
+    return SchemeRouter(table, **kw)
+
+
+def test_router_cost_table_seed_roundtrip():
+    r = _router(constructions=("logn",))
+    assert r.cost_table() == {}          # no probe, nothing measured
+    seeded = {"logn@4": 0.002, "logn@8": 0.004,
+              "radix4@8": 0.1,           # unknown construction here
+              "overhead_s": 0.01}        # CostTable metadata key
+    assert r.seed_costs(seeded) == 2     # only the logn rows apply
+    assert r.cost_table() == {"logn@4": 0.002, "logn@8": 0.004}
+    # tuple keys are accepted too (the in-memory spelling)
+    assert r.seed_costs({("logn", 16): 0.008}) == 1
+    # the exported table is directly consumable by the twin
+    ct = CostTable(r.cost_table())
+    assert ct.service_s("logn", 16) == 0.008
+
+
+def test_engine_drain_then_close_rejects_cleanly():
+    from dpf_tpu import DPF
+    from dpf_tpu.serve import ServingEngine
+    from dpf_tpu.serve.engine import EngineClosed
+    dpf = DPF(prf=DPF.PRF_DUMMY)
+    table = np.random.default_rng(21).integers(
+        0, 2 ** 31, (N, ENTRY), dtype=np.int32)
+    dpf.eval_init(table)
+    keys = [dpf.gen(i % N, N, seed=b"drain-%d" % i)[0]
+            for i in range(6)]
+    eng = ServingEngine(dpf, max_in_flight=2, buckets=(4, 8))
+    futs = [eng.submit(keys[:3]) for _ in range(4)]
+    eng.drain()                          # in-flight work completes
+    assert eng.in_flight == 0 and not eng._pending
+    refs = np.asarray(dpf.eval_cpu(keys[:3]))
+    for f in futs:
+        assert np.array_equal(f.result(), refs)
+    assert eng.stats.batches_submitted == 4
+    assert eng.stats.queries_submitted == 12
+    assert not eng.closed
+    eng.close()
+    assert eng.closed
+    with pytest.raises(EngineClosed):
+        eng.submit(keys[:1])
+    eng.close()                          # idempotent
+    # counters unchanged by the rejected submit
+    assert eng.stats.batches_submitted == 4
+
+
+def test_router_drain_and_close():
+    from dpf_tpu.serve.engine import EngineClosed
+    r = _router(constructions=("logn",))
+    srv = r.server("logn")
+    keys = [srv.gen(i % N, N, seed=b"rc-%d" % i)[0] for i in range(4)]
+    futs = [r.submit(r.route(2), keys[:2]) for _ in range(3)]
+    r.drain()
+    refs = np.asarray(srv.eval_cpu(keys[:2]))
+    for f in futs:
+        assert np.array_equal(f.result(), refs)
+    assert r.counters().batches_submitted == 3
+    r.close()
+    with pytest.raises(EngineClosed):
+        r.submit(r.route(2), keys[:2])
+    # EngineClosed is a decision, not a fault: breakers stay closed
+    assert all(b.state == "closed" for b in r.breakers.values())
+
+
+def test_tenant_router_drain_and_close():
+    from dpf_tpu.serve.engine import EngineClosed
+    from dpf_tpu.serve.registry import TableRegistry
+    from dpf_tpu.serve.tenant import TenantRouter, TenantSpec
+    from dpf_tpu.serve.bench_load import _batch_for, _key_pool
+    tr = TenantRouter(TableRegistry(labels=("logn",)))
+    table = np.random.default_rng(29).integers(
+        0, 2 ** 31, (N, ENTRY), dtype=np.int32)
+    tr.add_tenant(TenantSpec("a", table=table, cap=CAP, probe=False))
+    pool = _key_pool(tr.router("a").server("logn"), N, 4, b"tn-close")
+
+    def keys_for(lb):
+        return _batch_for(pool, 0, 2)[0]
+
+    fut = tr.submit("a", 2, keys_for)
+    tr.drain()
+    assert np.array_equal(fut.result(),
+                          pool[1][_batch_for(pool, 0, 2)[1]])
+    tr.close()
+    with pytest.raises(EngineClosed):
+        tr.submit("a", 2, keys_for)
+    tr.close()                           # idempotent
+
+
+def test_replica_pool_scales_against_real_engines():
+    from dpf_tpu.serve import ServingEngine
+    from dpf_tpu.serve.engine import EngineClosed
+    from dpf_tpu.plan.autoscale import ReplicaPool
+    r = _router(constructions=("logn",))
+    srv = r.server("logn")
+    keys = [srv.gen(i % N, N, seed=b"rp-%d" % i)[0] for i in range(4)]
+    refs = np.asarray(srv.eval_cpu(keys))
+    pool = ReplicaPool(
+        lambda: ServingEngine(srv, max_in_flight=2, buckets=r.buckets,
+                              label="logn"),
+        policy=AutoscalePolicy(max_replicas=2), initial=1)
+    futs = [pool.submit(keys[:2]) for _ in range(3)]
+    pool.scale_up()
+    assert len(pool.replicas) == 2 and pool.scale_ups == 1
+    futs.append(pool.submit(keys))
+    eng_kept = pool.replicas[0]
+    assert pool.scale_down()             # drains via engine.drain()
+    assert len(pool.replicas) == 1 and pool.scale_downs == 1
+    assert not pool.scale_down()         # floor of one replica
+    for f in futs[:3]:
+        assert np.array_equal(f.result(), refs[:2])
+    assert np.array_equal(futs[3].result(), refs)
+    secs = pool.close()
+    assert secs > 0 and not pool.replicas
+    with pytest.raises(EngineClosed):
+        eng_kept.submit(keys[:1])
