@@ -9,6 +9,7 @@
 #   --autotune         tune/search.autotune_sweep   BENCH_TUNE_r07.json
 #   --autotune-scheme  tune/search.scheme_sweep     BENCH_SCHEME_r13.json
 #   --autotune-kernel  tune/kernel_search           BENCH_KSEARCH_r15.json
+#     --family=...                                  BENCH_KSEARCH2_r18.json
 #   --batch-pir        serve/bench_pir.py           BENCH_PIR_r09.json
 #   --multichip        serve/bench_multichip.py     MULTICHIP_r06.json
 #   --load             serve/bench_load.py          BENCH_LOAD_r10.json
@@ -33,15 +34,22 @@
 # construction in the same tuning cache.
 #
 # --autotune-kernel: one level down — generative search over
-# STRUCTURED kernel variants of the sqrt-N PRF->contract program
-# (tile shape, VMEM cell budget, grid order/dimension semantics,
-# limb emission, codeword-select fusion for the Pallas family; scan
-# row_chunk x dot_impl for the XLA family), seeded from the staged
-# descent winner, mutate/tournament selection, every timed candidate
-# equality-gated against the scalar oracle and every Pallas variant
-# additionally gated via interpret-mode parity; winners persist as
+# STRUCTURED kernel variants, seeded from the staged descent winner,
+# mutate/tournament selection, every timed candidate equality-gated
+# against its scalar oracle and every Pallas variant additionally
+# gated via interpret-mode parity.  --family picks the space:
+# "sqrtn" (default; the PR-15 PRF->contract space: tile shape, VMEM
+# cell budget, grid order/dimension semantics, limb emission,
+# codeword-select fusion for the Pallas family; scan row_chunk x
+# dot_impl for the XLA family), "logn" (the GGM expansion space:
+# chunk_leaves x f_levels level fusion x fused/dispatch/subtree-kernel
+# drive x dot_impl), "keygen" (the batched-keygen space: SHAKE squeeze
+# batching x prf_v call grouping x target-path reuse; fitness keys/s,
+# key bytes invariant), or "all"/comma lists.  Winners persist as
 # kvariant cache entries that resolve with
-# kernel_resolved_from="searched".  See docs/TUNING.md.
+# kernel_resolved_from="searched" (eval) or ride DPF.gen_batch
+# (keygen).  The multi-family record is BENCH_KSEARCH2_r18.json.
+# See docs/TUNING.md.
 #
 # --multichip: the mesh rehearsal matrix (all three constructions x
 # every mesh split x shape through the mesh autotuner) on a forced-
@@ -155,6 +163,11 @@ def _autotune_kernel_main(argv):
     ap.add_argument("--reps", type=int, default=3)
     ap.add_argument("--generations", type=int, default=3)
     ap.add_argument("--population", type=int, default=6)
+    ap.add_argument("--family", default="sqrtn",
+                    help="variant space(s): sqrtn|logn|keygen|all or a "
+                         "comma list (default sqrtn — the PR-15 space; "
+                         "logn searches the GGM expansion, keygen the "
+                         "batched generators)")
     ap.add_argument("--force", action="store_true",
                     help="re-search even with a warm kvariant cache")
     ap.add_argument("--dryrun", action="store_true",
@@ -170,8 +183,9 @@ def _autotune_kernel_main(argv):
                        for p in args.shapes.split(","))
     kernel_search_sweep(shapes, prf_method=args.prf, reps=args.reps,
                         generations=args.generations,
-                        population=args.population, force=args.force,
-                        dryrun=args.dryrun, out=args.out)
+                        population=args.population, family=args.family,
+                        force=args.force, dryrun=args.dryrun,
+                        out=args.out)
 
 
 def _autotune_scheme_main(argv):

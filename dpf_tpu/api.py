@@ -60,13 +60,17 @@ def _native_gen(k, n, seed, prf_method):
         return None
 
 
-def gen_batched_binary(alphas, n, seeds, prf_method: int):
+def gen_batched_binary(alphas, n, seeds, prf_method: int, knobs=None):
     """Fastest available batched BINARY keygen: the native C++ per-key
     generator when the extension is built (byte-identical to the Python
     DRBG construction, ~an order of magnitude faster per key than the
     vectorized numpy path at small depths), else
     ``keygen.gen_batched``.  Returns two [B, 524] int32 arrays either
-    way; shared by ``DPF.gen_batch`` and the batch-PIR client."""
+    way; shared by ``DPF.gen_batch`` and the batch-PIR client.
+
+    ``knobs``: searched keygen-variant knobs (``tune.kernel_search``),
+    consumed only by the numpy path — the native loop keeps precedence
+    (it has no such knobs and is already the per-key fast path)."""
     # same argument validation as the numpy path (short seed lists and
     # out-of-range alphas must not reach the native loop)
     alphas, seeds = keygen._check_batch_args(alphas, n, seeds)
@@ -86,7 +90,8 @@ def gen_batched_binary(alphas, n, seeds, prf_method: int):
         if all(o is not None for o in outs):
             return (np.stack([a for a, _ in outs]),
                     np.stack([b for _, b in outs]))
-    return keygen.gen_batched(alphas, n, seeds, prf_method=prf_method)
+    return keygen.gen_batched(alphas, n, seeds, prf_method=prf_method,
+                              knobs=knobs)
 
 
 def _native_expand_batch(keys, prf_method):
@@ -182,6 +187,10 @@ class DPF(object):
         self.prf_method_string = PRF_NAMES[self.prf_method]
         self.strict = strict          # enforce reference shape limits
         self._tuned_cache = {}        # batch -> tuning-cache knob dict
+        # (n, pow2 batch) -> searched keygen knobs or None; its own memo
+        # because gen_batch runs before any eval_init and keys on the
+        # GEN domain, not the table shape
+        self._keygen_knobs_cache = {}
         self.table = None             # original table (numpy int32)
         self.table_device = None      # permuted table on device (jnp)
         self.table_num_entries = None
@@ -291,22 +300,60 @@ class DPF(object):
         tensors; row i is bit-identical to
         ``gen(indices[i], n, seed=seeds[i])`` (the scalar generator is
         the fuzz oracle, tests/test_api.py)."""
+        import time as _time
         indices = np.asarray(indices, dtype=np.int64).reshape(-1)
         n = self._check_gen_domain(
             int(indices.max()) if indices.size else 0, n)
         self._ensure_scheme(n)
+        knobs = self._resolved_keygen_knobs(n, indices.size)
+        t0 = _time.perf_counter()
         if self.scheme == "sqrtn":
             from .core import sqrtn
+            construction = "sqrtn.r2"
             wa, wb = sqrtn.gen_sqrt_batched(indices, n, seeds,
-                                            prf_method=self.prf_method)
+                                            prf_method=self.prf_method,
+                                            knobs=knobs)
         elif self.radix == 4:
             from .core import radix4
+            construction = "logn.r4"
             wa, wb = radix4.gen_batched_r4(indices, n, seeds,
-                                           prf_method=self.prf_method)
+                                           prf_method=self.prf_method,
+                                           knobs=knobs)
         else:
+            construction = "logn.r2"
             wa, wb = gen_batched_binary(indices, n, seeds,
-                                        self.prf_method)
+                                        self.prf_method, knobs=knobs)
+        try:  # observability must never break keygen
+            from .obs.metrics import observe_keygen
+            observe_keygen(construction, indices.size,
+                           _time.perf_counter() - t0)
+        except Exception as e:  # pragma: no cover
+            from .utils.profiling import note_swallowed
+            note_swallowed("api.keygen_metrics", e)
         return _maybe_torch(wa, True), _maybe_torch(wb, True)
+
+    def _resolved_keygen_knobs(self, n: int, batch: int) -> dict | None:
+        """Searched batched-keygen knobs for this (scheme, radix, n,
+        batch), or None (the PR-4 baseline).  Same precedence family as
+        ``resolved_eval_knobs``: there are no EvalConfig keygen fields,
+        so the searched ``kvariant`` entry (``tune.kernel_search``
+        "keygen" family, ``lookup_keygen_variant``) is the only rung
+        above the baseline.  Memoized per (n, pow2 batch) so the hot
+        batch-PIR client path pays one cache lookup per shape, and
+        guarded on the variant family so a GGM/sqrt-N entry can never
+        ride a keygen call."""
+        from .core.u128 import next_pow2
+        key = (n, next_pow2(max(1, batch)))
+        memo = self._keygen_knobs_cache
+        if key not in memo:
+            from .tune.cache import lookup_keygen_variant
+            rec = lookup_keygen_variant(
+                n=n, batch=key[1], prf_method=self.prf_method,
+                scheme=self.scheme, radix=self.radix) or {}
+            fam = (rec.get("kernel_variant") or {}).get("family")
+            kk = rec.get("keygen_knobs")
+            memo[key] = dict(kk) if (kk and fam == "keygen") else None
+        return memo[key]
 
     # ----------------------------------------------------------- eval_init
 
@@ -556,19 +603,21 @@ class DPF(object):
                     n=n, entry_size=self.table_effective_entry_size,
                     batch=batch, prf_method=self.prf_method,
                     scheme=self.scheme, radix=self.radix) or {}
-                if self.scheme == "sqrtn":
-                    # searched kernel variants (tune/kernel_search.py)
-                    # live under their own "kvariant" entry kind and
-                    # ride in the memo's reserved "_searched" slot —
-                    # a tuner's measurement pin (a bare knob dict)
-                    # never carries one, so a pinned candidate is
-                    # timed as itself, not hijacked by a prior search
-                    from .tune.cache import lookup_kernel_variant
-                    searched = lookup_kernel_variant(
-                        n=n, entry_size=self.table_effective_entry_size,
-                        batch=batch, prf_method=self.prf_method)
-                    if searched:
-                        tuned = {**tuned, "_searched": searched}
+                # searched kernel variants (tune/kernel_search.py)
+                # live under their own "kvariant" entry kind and
+                # ride in the memo's reserved "_searched" slot —
+                # a tuner's measurement pin (a bare knob dict)
+                # never carries one, so a pinned candidate is
+                # timed as itself, not hijacked by a prior search.
+                # The kvariant key carries (scheme, radix), so sqrt-N
+                # and GGM entries never answer each other's lookups.
+                from .tune.cache import lookup_kernel_variant
+                searched = lookup_kernel_variant(
+                    n=n, entry_size=self.table_effective_entry_size,
+                    batch=batch, prf_method=self.prf_method,
+                    scheme=self.scheme, radix=self.radix)
+                if searched:
+                    tuned = {**tuned, "_searched": searched}
             else:
                 tuned = {}
             self._tuned_cache[batch] = tuned
@@ -591,6 +640,12 @@ class DPF(object):
             # note_swallowed) so a tuning cache written on a TPU stays
             # usable on this machine
             searched = tuned.get("_searched") or {}
+            if (searched.get("kernel_variant") or {}).get("family") in (
+                    "ggm", "keygen"):
+                # defense in depth: the kvariant key discipline already
+                # separates the families, but a GGM/keygen entry must
+                # never ride a sqrt-N dispatch even if hand-planted
+                searched = {}
             explicit_k = cfg.kernel_impl if cfg is not None else None
             if not is_auto(explicit_k):
                 kernel, kernel_from = explicit_k, "config"
@@ -671,9 +726,58 @@ class DPF(object):
                             % (row_chunk, kernel_from, eff)))
             return out
 
-        kernel_impl = pick("kernel_impl", "xla")
+        # ---- logn (GGM) resolution.  A searched "ggm"-family kernel
+        # variant (tune/kernel_search.py) outranks the staged-descent
+        # knobs exactly like the sqrt-N branch; any other family in the
+        # slot (pre-family sqrt-N entries, keygen variants) never rides
+        # a logn dispatch — the kvariant key discipline already keeps
+        # them out, this guard is the defense in depth the
+        # backward-compat tests pin.
+        searched = tuned.get("_searched") or {}
+        variant = searched.get("kernel_variant") or {}
+        if variant.get("family") != "ggm":
+            searched, variant = {}, {}
+        explicit_k = cfg.kernel_impl if cfg is not None else None
+        if not is_auto(explicit_k):
+            kernel_impl, kernel_from = explicit_k, "config"
+        elif searched.get("kernel_impl") is not None:
+            kernel_impl, kernel_from = searched["kernel_impl"], "searched"
+        elif tuned.get("kernel_impl") is not None:
+            kernel_impl, kernel_from = tuned["kernel_impl"], "tuned"
+        else:
+            kernel_impl, kernel_from = "xla", "heuristic"
+        if kernel_from != "searched":
+            searched, variant = {}, {}
+        if kernel_impl == "pallas" and kernel_from in ("searched",
+                                                       "tuned"):
+            # a cache written where the subtree kernel compiles must
+            # stay usable here: degrade to the xla scan instead of
+            # raising (an EXPLICIT config "pallas" still passes through
+            # and fails loudly at dispatch)
+            from .utils.compat import has_pallas_sqrt_kernel
+            if not has_pallas_sqrt_kernel():
+                from .utils.profiling import note_swallowed
+                note_swallowed(
+                    "api.ggm_kernel_unavailable",
+                    RuntimeError(
+                        "kernel_impl='pallas' (from %s) but Pallas/TPU "
+                        "is unavailable here" % kernel_from))
+                kernel_impl, kernel_from = "xla", "degraded"
+                searched, variant = {}, {}
+        depth = n.bit_length() - 1
+        f_levels = searched.get("f_levels")
+        chunk_req = chunk_from = None
         if cfg is not None and cfg.chunk_leaves:
-            chunk = min(cfg.chunk_leaves, n)
+            chunk_req, chunk_from = int(cfg.chunk_leaves), "config"
+            chunk = min(chunk_req, n)
+        elif searched.get("chunk_leaves"):
+            # the searched (chunk, f_levels, dot) were gated with THEIR
+            # kernel; the live-seed budget is still re-checked (the
+            # nearest-batch fallback can pair a small-batch chunk with
+            # a bigger batch)
+            chunk_req, chunk_from = int(searched["chunk_leaves"]), \
+                "searched"
+            chunk = expand.clamp_chunk(chunk_req, n, batch)
         elif (tuned.get("chunk_leaves")
                 and tuned.get("kernel_impl", kernel_impl) == kernel_impl):
             # the tuner gated (chunk, kernel) together — a tuned chunk
@@ -682,29 +786,66 @@ class DPF(object):
             # falls through to that kernel's own heuristic) and is
             # re-checked against the live-seed budget (nearest-batch
             # fallback can pair a small-batch chunk with a bigger batch)
-            chunk = expand.clamp_chunk(tuned["chunk_leaves"], n, batch)
+            chunk_req, chunk_from = int(tuned["chunk_leaves"]), "tuned"
+            chunk = expand.clamp_chunk(chunk_req, n, batch)
         elif (kernel_impl == "pallas" and self.radix == 2
                 and self.prf_method != PRF_AES128):
             # subtree-kernel chunk is bounded by per-tile VMEM state;
-            # the AES plane-level kernel uses the standard memory bound
+            # the AES plane-level kernel uses the standard memory bound.
+            # A searched f_levels IS the chunk here (C = N >> f_levels)
             from .ops.pallas_level import pallas_chunk_leaves
-            chunk = pallas_chunk_leaves(n)
+            chunk = ((n >> int(f_levels)) if f_levels
+                     else pallas_chunk_leaves(n))
         else:
             chunk = expand.clamp_chunk(None, n, batch)
+        clamped = chunk_req is not None and chunk != chunk_req
+        if clamped:
+            # satellite of the sqrt-N row_chunk_effective move: a
+            # silently-clamped request is surfaced, never swallowed
+            from .utils.profiling import note_swallowed
+            note_swallowed(
+                "api.chunk_leaves_clamped",
+                RuntimeError(
+                    "requested chunk_leaves %d (from %s) clamped to %d "
+                    "by the live-seed budget" % (chunk_req, chunk_from,
+                                                 chunk)))
+        if f_levels is not None and self.radix == 2:
+            # a clamped/overridden chunk can invalidate the searched
+            # phase split (f_levels must cover at least log2(N/C))
+            base = depth - int(chunk).bit_length() + 1
+            if not base <= int(f_levels) <= depth:
+                f_levels = None
         if cfg is not None and cfg.round_unroll is not None:
             round_unroll = cfg.round_unroll
         elif "round_unroll" in tuned:  # the tuner's measurement pin
             round_unroll = tuned["round_unroll"]
         else:
             round_unroll = _prf.ROUND_UNROLL
-        return {
+        if kernel_from == "searched" and (cfg is None
+                                          or is_auto(cfg.dot_impl)):
+            dot = searched.get("dot_impl") or matmul128.default_impl()
+        else:
+            dot = pick("dot_impl", matmul128.default_impl())
+        if kernel_from == "searched" and (
+                cfg is None or is_auto(cfg.dispatch_group)):
+            group = searched.get("dispatch_group")
+        else:
+            group = pick("dispatch_group", None)
+        out = {
             "chunk_leaves": chunk,
-            "dot_impl": pick("dot_impl", matmul128.default_impl()),
+            "dot_impl": dot,
             "aes_impl": pick("aes_impl", _prf._aes_pair_impl()),
             "round_unroll": round_unroll,
             "kernel_impl": kernel_impl,
-            "dispatch_group": pick("dispatch_group", None),
+            "dispatch_group": group,
+            "kernel_resolved_from": kernel_from,
+            "f_levels": f_levels,
         }
+        if variant:
+            out["kernel_variant"] = variant
+        if clamped:
+            out["chunk_leaves_effective"] = chunk
+        return out
 
     def _dispatch_packed(self, pk: keygen.PackedKeys):
         """Dispatch one packed batch to the device and return the device
@@ -737,7 +878,9 @@ class DPF(object):
             cw1, cw2, last, self.table_device, depth=depth,
             prf_method=self.prf_method, chunk_leaves=chunk,
             dot_impl=k["dot_impl"], aes_impl=k["aes_impl"],
-            round_unroll=k["round_unroll"], kernel_impl=k["kernel_impl"])
+            round_unroll=k["round_unroll"], kernel_impl=k["kernel_impl"],
+            f_levels=k.get("f_levels"),
+            pallas_tb=(k.get("kernel_variant") or {}).get("tb"))
 
     def _dispatch_packed_sqrt(self, pk):
         """Sqrt-N device dispatch: row-chunked fused PRF-grid evaluation
@@ -818,7 +961,8 @@ class DPF(object):
                 cw1, cw2, last, self.table_device, n=n,
                 prf_method=self.prf_method, chunk_leaves=k["chunk_leaves"],
                 dot_impl=k["dot_impl"], aes_impl=k["aes_impl"],
-                round_unroll=k["round_unroll"])
+                round_unroll=k["round_unroll"],
+                f_levels=k.get("f_levels"))
         return out
 
     # ------------------------------------------------------------ eval_cpu

@@ -94,6 +94,24 @@ def chunk_candidates(n: int, batch: int, span: int = 2) -> list:
     return sorted(out)
 
 
+def f_level_candidates(n: int, chunk: int, batch: int,
+                       span: int = 3) -> list:
+    """Legal ``f_levels`` overrides for one (n, chunk_leaves) pair: the
+    phase-1/phase-2 split may sit anywhere from the chunk-implied
+    frontier (``log2(n/chunk)`` — the pre-search behavior, always a
+    member) down the tree, as long as the fully-materialized frontier
+    seed tensor [B, 2^f_levels, 4] honors the same 64 MiB live-seed
+    bound that phase 2 does.  At most ``span`` extra levels are offered
+    (each one doubles the frontier).  Sorted ascending."""
+    depth = int(np.log2(n))
+    base = depth - int(np.log2(max(1, int(chunk))))
+    out = []
+    for fl in range(base, min(depth, base + span) + 1):
+        if (1 << fl) * 16 * max(1, batch) <= CHUNK_SEED_BYTES_BOUND:
+            out.append(fl)
+    return out or [base]
+
+
 def _level_step_pair(seeds, cw1_pair, cw2_pair, prf_method: int,
                      aes_impl: str | None = None,
                      round_unroll: bool | None = None):
@@ -134,30 +152,44 @@ def permute_table(table_i32: np.ndarray) -> np.ndarray:
 
 def _expand_contract_core(cw1, cw2, last, per_chunk_tables, dot_fn, *,
                           depth, prf_method, f, aes_impl, round_unroll,
-                          out_width):
+                          out_width, f_levels=None):
     """Shared engine for the fused kernels: phase-1 frontier expansion, then
     a scan over frontier subtrees applying `dot_fn(leaves, chunk)` against
-    `per_chunk_tables` ([F, ...] with chunk on the leading axis)."""
+    `per_chunk_tables` ([F, ...] with chunk on the leading axis).
+
+    ``f_levels`` decouples the phase-1/phase-2 split from the contraction
+    chunk (the kernel-search "level-fusion frontier" axis): phase 1 may
+    expand PAST the ``log2(f)`` frontier the chunk implies, in which case
+    each scan step takes ``2^f_levels / f`` consecutive frontier nodes and
+    expands them together through the remaining levels — the leaves still
+    land in the same BFS order, so the contraction (and the answer) is
+    bit-identical; only the materialization/scan balance moves.  ``None``
+    keeps the pre-search behavior (``f_levels == log2(f)``)."""
     bsz = last.shape[0]
     seeds = last[:, None, :]  # [B, 1, 4]
-    f_levels = int(np.log2(f))
+    base_levels = int(np.log2(f))
+    f_levels = base_levels if f_levels is None else int(f_levels)
+    assert base_levels <= f_levels <= depth, (
+        "f_levels %d outside [log2(f)=%d, depth=%d]"
+        % (f_levels, base_levels, depth))
     # Phase 1: root -> frontier (levels depth-1 .. depth-f_levels)
     for l in range(f_levels):
         seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method,
                             aes_impl, round_unroll)
+    g = (1 << f_levels) // f  # frontier nodes per contraction chunk
 
     def expand_subtree(node_seeds):
-        """[B, 4] frontier seeds -> [B, C] low-32 leaf shares."""
-        s = node_seeds[:, None, :]
+        """[B, g, 4] frontier seeds -> [B, C] low-32 leaf shares."""
+        s = node_seeds
         for l in range(f_levels, depth):
             s = _level_step(s, cw1, cw2, depth - 1 - l, prf_method,
                             aes_impl, round_unroll)
         return s[..., 0].astype(jnp.int32)  # low limb, [B, C]
 
     if f == 1:
-        return dot_fn(expand_subtree(seeds[:, 0, :]), per_chunk_tables[0])
+        return dot_fn(expand_subtree(seeds), per_chunk_tables[0])
 
-    frontier = jnp.moveaxis(seeds, 1, 0)  # [F, B, 4]
+    frontier = jnp.moveaxis(seeds.reshape(bsz, f, g, 4), 1, 0)  # [F,B,g,4]
 
     def body(acc, xs):
         node_seeds, chunk = xs
@@ -171,12 +203,15 @@ def _expand_contract_core(cw1, cw2, last, per_chunk_tables, dot_fn, *,
 @functools.partial(jax.jit, static_argnames=("depth", "prf_method",
                                              "chunk_leaves", "dot_impl",
                                              "aes_impl", "round_unroll",
-                                             "kernel_impl"))
+                                             "kernel_impl", "f_levels",
+                                             "pallas_tb"))
 def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
                         prf_method: int, chunk_leaves: int,
                         dot_impl: str = "i32", aes_impl: str | None = None,
                         round_unroll: bool | None = None,
-                        kernel_impl: str = "xla"):
+                        kernel_impl: str = "xla",
+                        f_levels: int | None = None,
+                        pallas_tb: int | None = None):
     """Batched fused DPF evaluation against one shared table.
 
     Args:
@@ -186,6 +221,11 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
       depth: log2(N); prf_method: static PRF id; chunk_leaves: C.
       kernel_impl: "xla" (scan + fused dot) or "pallas" (hand-scheduled
         subtree kernel, ChaCha/Salsa — see ops/pallas_level.py).
+      f_levels: optional phase-1/phase-2 split override (the kernel
+        search's level-fusion frontier axis; None = log2(N/C), the
+        pre-search behavior).  Bit-identical for any legal value.
+      pallas_tb: optional key-tile override for the Pallas subtree
+        kernel (searched GGM variants; None = the hand-tuned default).
 
     Returns [B, E] int32 server output shares.
     """
@@ -207,12 +247,13 @@ def expand_and_contract(cw1, cw2, last, table_perm, *, depth: int,
             "kernel_impl='pallas' supports ChaCha20/Salsa20(+_BLK)/AES128")
         return _expand_contract_pallas(cw1, cw2, last, table_perm,
                                        depth=depth, f=f,
-                                       prf_method=prf_method)
+                                       prf_method=prf_method,
+                                       f_levels=f_levels, tb=pallas_tb)
     return _expand_contract_core(
         cw1, cw2, last, table_perm.reshape(f, c, e),
         lambda leaves, chunk: _dot_i32(leaves, chunk, dot_impl),
         depth=depth, prf_method=prf_method, f=f, aes_impl=aes_impl,
-        round_unroll=round_unroll, out_width=e)
+        round_unroll=round_unroll, out_width=e, f_levels=f_levels)
 
 
 @functools.partial(jax.jit, static_argnames=("dot_impl",))
@@ -303,17 +344,21 @@ def eval_dispatch(cw1, cw2, last, table_perm, *, depth: int,
 
 def _expand_contract_pallas(cw1, cw2, last, table_perm, *, depth: int,
                             f: int, interpret: bool = False,
-                            prf_method: int = 2):
+                            prf_method: int = 2,
+                            f_levels: int | None = None,
+                            tb: int | None = None):
     """Phase-1 frontier via XLA (tiny), phase-2 via the fused Pallas
-    subtree kernel."""
+    subtree kernel.  ``f_levels``/``tb`` are the searched GGM variant's
+    structure overrides (None = the chunk-implied split and the
+    hand-tuned key tile)."""
     from ..ops.pallas_level import subtree_contract_pallas
     seeds = last[:, None, :]
-    f_levels = int(np.log2(f))
+    f_levels = int(np.log2(f)) if f_levels is None else int(f_levels)
     for l in range(f_levels):
         seeds = _level_step(seeds, cw1, cw2, depth - 1 - l, prf_method)
     return subtree_contract_pallas(
         seeds, cw1, cw2, table_perm, depth=depth, f_levels=f_levels,
-        interpret=interpret, prf_method=prf_method)
+        interpret=interpret, tb=tb, prf_method=prf_method)
 
 
 def choose_group(f: int, c: int) -> int:

@@ -286,7 +286,8 @@ def generate_keys(alpha: int, n: int, seed: bytes, prf_method: int,
 # Batched key generation (vectorized over B independent indices)
 # ---------------------------------------------------------------------------
 
-def drbg_u128_batch(seeds, n_draws: int) -> np.ndarray:
+def drbg_u128_batch(seeds, n_draws: int, *,
+                    squeeze_draws: int | None = None) -> np.ndarray:
     """Every key's first ``n_draws`` DRBG u128 draws: [B, n_draws, 4] uint32.
 
     ``Shake256Drbg`` is a pure byte stream, so drawing ``16 * n_draws``
@@ -296,12 +297,56 @@ def drbg_u128_batch(seeds, n_draws: int) -> np.ndarray:
     single SHAKE squeeze + frombuffer per key.  Draw-site modifications
     (``& ~1`` / ``| 1`` of the odd/even draws) are applied by the
     callers on the limb arrays, vectorized over the batch.
+
+    ``squeeze_draws`` caps the draws squeezed per ``bytes()`` call (a
+    searched keygen knob): chunked reads of the same stream are
+    byte-identical, only the SHAKE refill / copy granularity moves.
     """
+    sq = n_draws if not squeeze_draws else max(1, int(squeeze_draws))
     out = np.empty((len(seeds), n_draws, 4), dtype=np.uint32)
     for i, s in enumerate(seeds):
-        out[i] = np.frombuffer(Shake256Drbg(s).bytes(16 * n_draws),
-                               dtype=np.uint32).reshape(n_draws, 4)
+        rng = Shake256Drbg(s)
+        for lo in range(0, n_draws, sq):
+            m = min(sq, n_draws - lo)
+            out[i, lo:lo + m] = np.frombuffer(
+                rng.bytes(16 * m), dtype=np.uint32).reshape(m, 4)
     return out
+
+
+def _keygen_knob_fns(prf_method: int, knobs):
+    """Resolve searched keygen knobs (``tune.kernel_search`` "keygen"
+    family) into the call-shape closures the batched generators share.
+
+    Every knob is a bit-identical reformulation of the PR-4 baseline
+    (``knobs=None``), relying only on the PRF's row-wise purity:
+
+    * ``prf_group="stacked"`` — one ``prf_v`` call per branch over the
+      stacked s1‖s2 seeds instead of two half-size calls.
+    * ``path_reuse="reuse"`` — the target-path PRF values are selected
+      from the saved per-branch outputs instead of recomputed with a
+      per-row ``pos`` vector.
+    * ``squeeze_draws`` — DRBG squeeze chunking (``drbg_u128_batch``).
+
+    Returns ``(prf_pair_v, path_pick, squeeze_draws)``.
+    """
+    from .prf import prf_v
+    kn = dict(knobs or {})
+    stacked = kn.get("prf_group") == "stacked"
+    reuse = kn.get("path_reuse") == "reuse"
+
+    def prf_pair_v(sa, sb, b):
+        if stacked:
+            both = prf_v(prf_method, np.concatenate([sa, sb], axis=0), b)
+            h = sa.shape[0]
+            return both[:h], both[h:]
+        return prf_v(prf_method, sa, b), prf_v(prf_method, sb, b)
+
+    def path_pick(saved, seeds, tb, rows):
+        if reuse:
+            return np.stack(saved, axis=1)[rows, tb]
+        return prf_v(prf_method, seeds, tb)
+
+    return prf_pair_v, path_pick, kn.get("squeeze_draws")
 
 
 def _check_batch_args(alphas, n: int, seeds):
@@ -350,7 +395,7 @@ def _wire_batch(cw1, cw2, last, depth: int, n: int,
 
 
 def gen_batched(alphas, n: int, seeds=None, *, prf_method: int,
-                beta: int = 1):
+                beta: int = 1, knobs=None):
     """Vectorized two-server keygen over B independent point functions.
 
     The batched counterpart of ``generate_keys`` for a uniform domain
@@ -361,19 +406,24 @@ def gen_batched(alphas, n: int, seeds=None, *, prf_method: int,
     ``generate_keys(alphas[i], n, seeds[i])`` per key (the scalar
     generator stays the fuzz oracle; asserted in tests/test_keygen.py).
 
+    ``knobs`` selects among bit-identical searched reformulations
+    (``_keygen_knob_fns``: prf_group / path_reuse / squeeze_draws);
+    ``None`` is the PR-4 baseline.
+
     Returns ``(wire_a, wire_b)``: two [B, 524] int32 arrays of
     serialized keys (rows are valid wire keys for every existing
     consumer, and the stacked form feeds ``stack_wire_keys`` with no
     re-stacking).
     """
-    from .prf import prf_v
     alphas, seeds = _check_batch_args(alphas, n, seeds)
     depth = n.bit_length() - 1
     if depth > MAX_DEPTH:
         raise ValueError("table size 2^%d exceeds max 2^32" % depth)
     bsz = alphas.size
+    prf_pair_v, path_pick, squeeze_draws = _keygen_knob_fns(
+        prf_method, knobs)
     n_draws = 4 if depth == 1 else 3 * depth + 1
-    draws = drbg_u128_batch(seeds, n_draws)
+    draws = drbg_u128_batch(seeds, n_draws, squeeze_draws=squeeze_draws)
     cur = 0
 
     def draw():
@@ -402,14 +452,18 @@ def gen_batched(alphas, n: int, seeds=None, *, prf_method: int,
     i = depth - 1
     b0 = bits[:, 0]
     c1 = [draw(), draw()]
+    p1, p2 = [], []
     for b in (0, 1):
-        d = u128.sub128(prf_v(prf_method, k1, b), prf_v(prf_method, k2, b))
+        v1, v2 = prf_pair_v(k1, k2, b)
+        p1.append(v1)
+        p2.append(v2)
+        d = u128.sub128(v1, v2)
         d = np.where((b0 == b)[:, None], u128.sub128(d, beta_l), d)
         cw1[:, 2 * i + b] = c1[b]
         cw2[:, 2 * i + b] = u128.add128(c1[b], d)
     c1_t = np.where((b0 == 1)[:, None], c1[1], c1[0])
-    s1 = u128.add128(prf_v(prf_method, k1, b0), c1_t)
-    s2 = u128.add128(prf_v(prf_method, k2, b0), cw2[rows, 2 * i + b0])
+    s1 = u128.add128(path_pick(p1, k1, b0, rows), c1_t)
+    s2 = u128.add128(path_pick(p2, k2, b0, rows), cw2[rows, 2 * i + b0])
 
     # --- upper levels, bottom to top --------------------------------------
     for l in range(1, depth):
@@ -423,9 +477,12 @@ def gen_batched(alphas, n: int, seeds=None, *, prf_method: int,
         tb = bits[:, l]
         s1_even = ((s1[:, 0] & np.uint32(1)) == 0)[:, None]
         c1 = [draw(), draw()]
+        p1, p2 = [], []
         for b in (0, 1):
-            d = u128.sub128(prf_v(prf_method, s2, b),
-                            prf_v(prf_method, s1, b))
+            v1, v2 = prf_pair_v(s1, s2, b)
+            p1.append(v1)
+            p2.append(v2)
+            d = u128.sub128(v2, v1)
             d = np.where(s1_even, u128.neg128(d), d)
             cw2[:, 2 * i + b] = u128.add128(c1[b], d)
         # fold beta into cw1 at the target branch (after cw2 is fixed)
@@ -437,9 +494,9 @@ def gen_batched(alphas, n: int, seeds=None, *, prf_method: int,
         # step both servers' target-path seeds through this level
         c1_t = np.where((tb == 1)[:, None], c1[1], c1[0])
         cw2_t = cw2[rows, 2 * i + tb]
-        n1 = u128.add128(prf_v(prf_method, s1, tb),
+        n1 = u128.add128(path_pick(p1, s1, tb, rows),
                          np.where(s1_even, c1_t, cw2_t))
-        n2 = u128.add128(prf_v(prf_method, s2, tb),
+        n2 = u128.add128(path_pick(p2, s2, tb, rows),
                          np.where(s1_even, cw2_t, c1_t))
         s1, s2 = n1, n2
 

@@ -251,7 +251,7 @@ def generate_keys_r4(alpha: int, n: int, seed: bytes, prf_method: int,
 
 
 def gen_batched_r4(alphas, n: int, seeds=None, *, prf_method: int,
-                   beta: int = 1):
+                   beta: int = 1, knobs=None):
     """Vectorized two-server mixed-radix keygen over B indices.
 
     The radix-4 counterpart of ``keygen.gen_batched``: one DRBG squeeze
@@ -259,9 +259,16 @@ def gen_batched_r4(alphas, n: int, seeds=None, *, prf_method: int,
     tensors.  Bit-identical to ``generate_keys_r4(alphas[i], n,
     seeds[i])`` per key (the scalar generator stays the fuzz oracle).
     Returns two [B, 524] int32 wire-key arrays.
+
+    ``knobs`` (searched, ``tune.kernel_search.keygen_search``) selects
+    among bit-identical reformulations: ``prf_group="stacked"`` fuses
+    the two per-branch PRF calls over s1‖s2 into one, ``path_reuse=
+    "reuse"`` selects the target-path PRF outputs from the saved branch
+    outputs instead of recomputing, ``squeeze_draws`` chunks the DRBG
+    squeeze (``keygen.drbg_u128_batch``).
     """
-    from .keygen import _check_batch_args, _wire_batch, drbg_u128_batch
-    from .prf import prf_v
+    from .keygen import (_check_batch_args, _keygen_knob_fns, _wire_batch,
+                         drbg_u128_batch)
     alphas, seeds = _check_batch_args(alphas, n, seeds)
     depth = n.bit_length() - 1
     if depth > 32:  # sum(arities) = 2*depth must fit MAX_CW
@@ -270,9 +277,11 @@ def gen_batched_r4(alphas, n: int, seeds=None, *, prf_method: int,
     offs = cw_offsets(ars)
     levels = len(ars)
     bsz = alphas.size
+    prf_pair_v, path_pick, squeeze_draws = _keygen_knob_fns(
+        prf_method, knobs)
     n_draws = 2 + (0 if levels == 1 else 1) + ars[0] + sum(
         (0 if j == levels - 1 else 1) + ars[j] for j in range(1, levels))
-    draws = drbg_u128_batch(seeds, n_draws)
+    draws = drbg_u128_batch(seeds, n_draws, squeeze_draws=squeeze_draws)
     cur = 0
 
     def draw():
@@ -305,14 +314,18 @@ def gen_batched_r4(alphas, n: int, seeds=None, *, prf_method: int,
     beta_l = beta_c if levels == 1 else odd(draw())
     tb = digits[:, 0]
     c1 = [draw() for _ in range(a0)]
+    p1, p2 = [], []
     for b in range(a0):
-        d = u128.sub128(prf_v(prf_method, k1, b), prf_v(prf_method, k2, b))
+        v1, v2 = prf_pair_v(k1, k2, b)
+        p1.append(v1)
+        p2.append(v2)
+        d = u128.sub128(v1, v2)
         d = np.where((tb == b)[:, None], u128.sub128(d, beta_l), d)
         cw1[:, offs[0] + b] = c1[b]
         cw2[:, offs[0] + b] = u128.add128(c1[b], d)
     c1_t = np.stack(c1, axis=1)[rows, tb]
-    s1 = u128.add128(prf_v(prf_method, k1, tb), c1_t)
-    s2 = u128.add128(prf_v(prf_method, k2, tb), cw2[rows, offs[0] + tb])
+    s1 = u128.add128(path_pick(p1, k1, tb, rows), c1_t)
+    s2 = u128.add128(path_pick(p2, k2, tb, rows), cw2[rows, offs[0] + tb])
 
     # --- upper levels, bottom to top -------------------------------------
     for j in range(1, levels):
@@ -326,9 +339,12 @@ def gen_batched_r4(alphas, n: int, seeds=None, *, prf_method: int,
         tb = digits[:, j]
         s1_even = ((s1[:, 0] & np.uint32(1)) == 0)[:, None]
         c1 = [draw() for _ in range(a)]
+        p1, p2 = [], []
         for b in range(a):
-            d = u128.sub128(prf_v(prf_method, s2, b),
-                            prf_v(prf_method, s1, b))
+            v1, v2 = prf_pair_v(s1, s2, b)
+            p1.append(v1)
+            p2.append(v2)
+            d = u128.sub128(v2, v1)
             d = np.where(s1_even, u128.neg128(d), d)
             cw2[:, offs[j] + b] = u128.add128(c1[b], d)
         adj = np.where(s1_even, beta_l, u128.neg128(beta_l))
@@ -338,9 +354,9 @@ def gen_batched_r4(alphas, n: int, seeds=None, *, prf_method: int,
             cw1[:, offs[j] + b] = c1[b]
         c1_t = np.stack(c1, axis=1)[rows, tb]
         cw2_t = cw2[rows, offs[j] + tb]
-        n1 = u128.add128(prf_v(prf_method, s1, tb),
+        n1 = u128.add128(path_pick(p1, s1, tb, rows),
                          np.where(s1_even, c1_t, cw2_t))
-        n2 = u128.add128(prf_v(prf_method, s2, tb),
+        n2 = u128.add128(path_pick(p2, s2, tb, rows),
                          np.where(s1_even, cw2_t, c1_t))
         s1, s2 = n1, n2
 
@@ -516,12 +532,22 @@ def _expand_contract_mixed_core(cw1, cw2, last, per_chunk_tables, dot_fn, *,
 
 def _expand_and_contract_mixed_jit(cw1, cw2, last, table_perm, *, n,
                                    prf_method, chunk_leaves, dot_impl,
-                                   aes_impl, round_unroll):
+                                   aes_impl, round_unroll, f_levels=None):
     from .expand import _dot_i32
     ars = arities(n)
     offs = cw_offsets(ars)
     e = table_perm.shape[1]
-    f_lv, c = _suffix_chunk(ars, chunk_leaves or n)
+    if f_levels is None:
+        f_lv, c = _suffix_chunk(ars, chunk_leaves or n)
+    else:
+        # searched override: phase 1 covers the first f_levels MIXED
+        # levels (not binary levels — the cache key carries the radix,
+        # so the two unit systems never mix)
+        f_lv = int(f_levels)
+        if not 0 <= f_lv < len(ars):
+            raise ValueError("f_levels (%d) out of range for arities %r"
+                             % (f_lv, ars))
+        c = int(np.prod(ars[f_lv:]))
     f = n // c
     return _expand_contract_mixed_core(
         cw1, cw2, last, table_perm.reshape(f, c, e),
@@ -536,12 +562,16 @@ _RUN_JIT = None  # module-level jit wrapper: one trace cache per process
 def expand_and_contract_mixed(cw1, cw2, last, table_perm, *, n: int,
                               prf_method: int, chunk_leaves: int | None,
                               dot_impl: str = "i32", aes_impl=None,
-                              round_unroll=None):
+                              round_unroll=None,
+                              f_levels: int | None = None):
     """Batched fused mixed-radix evaluation against one shared table.
 
     table_perm: [N, E] int32, pre-permuted with ``mixed_reverse_indices``.
     Returns [B, E] int32 shares.  The fused/monolithic counterpart of
-    ``expand.expand_and_contract`` for radix-4 keys.
+    ``expand.expand_and_contract`` for radix-4 keys.  ``f_levels``
+    overrides the ``_suffix_chunk`` split (mixed-level units); leaf
+    order and results are invariant, only the phase-1/phase-2 balance
+    moves.
     """
     import functools
     global _RUN_JIT
@@ -550,14 +580,15 @@ def expand_and_contract_mixed(cw1, cw2, last, table_perm, *, n: int,
         _RUN_JIT = functools.partial(
             jax.jit, static_argnames=("n", "prf_method", "chunk_leaves",
                                       "dot_impl", "aes_impl",
-                                      "round_unroll")
+                                      "round_unroll", "f_levels")
         )(_expand_and_contract_mixed_jit)
 
     import jax.numpy as jnp
     return _RUN_JIT(jnp.asarray(cw1), jnp.asarray(cw2), jnp.asarray(last),
                     table_perm, n=n, prf_method=prf_method,
                     chunk_leaves=chunk_leaves, dot_impl=dot_impl,
-                    aes_impl=aes_impl, round_unroll=round_unroll)
+                    aes_impl=aes_impl, round_unroll=round_unroll,
+                    f_levels=f_levels)
 
 
 def _per_key_tables_mixed_jit(cw1, cw2, last, tables_perm, *, n,

@@ -153,7 +153,8 @@ def generate_sqrt_keys(alpha: int, n: int, seed: bytes, prf_method: int,
 
 
 def gen_sqrt_batched(alphas, n: int, seeds=None, *, prf_method: int,
-                     beta: int = 1, n_keys: int | None = None):
+                     beta: int = 1, n_keys: int | None = None,
+                     knobs=None):
     """Vectorized two-server sqrt-N keygen over B independent indices.
 
     The sqrt-N counterpart of ``keygen.gen_batched``: one DRBG squeeze
@@ -162,9 +163,17 @@ def gen_sqrt_batched(alphas, n: int, seeds=None, *, prf_method: int,
     ``generate_sqrt_keys(alphas[i], n, seeds[i])`` per key (the scalar
     generator stays the fuzz oracle).  Returns two
     [B, (4 + K + 2R) * 4] int32 wire-key arrays.
+
+    ``knobs`` (searched, ``tune.kernel_search.keygen_search``):
+    ``prf_group="stacked"`` fuses the two target-column grid calls over
+    s1‖s2 into one; ``squeeze_draws`` chunks the DRBG squeeze.  Both
+    bit-identical reformulations (PRF row-wise purity / byte-stream
+    identity); the single-call grid has no target-path recomputation,
+    so ``path_reuse`` is vacuous here.
     """
     from .keygen import _check_batch_args, drbg_u128_batch
     alphas, seeds = _check_batch_args(alphas, n, seeds)
+    kn = dict(knobs or {})
     k = n_keys or default_split(n)[0]
     if n % k:
         raise ValueError("n_keys must divide n")
@@ -175,7 +184,8 @@ def gen_sqrt_batched(alphas, n: int, seeds=None, *, prf_method: int,
     # draw layout per key: k+1 column draws (the target column consumes
     # two — its server-1 seed, then server-2's opposite-LSB seed), then
     # one codeword draw per row — the exact scalar draw order
-    draws = drbg_u128_batch(seeds, k + 1 + r)
+    draws = drbg_u128_batch(seeds, k + 1 + r,
+                            squeeze_draws=kn.get("squeeze_draws"))
     rows_b = np.arange(bsz)
     col_idx = np.arange(k)[None, :] + (np.arange(k)[None, :] > j_t[:, None])
     keys1 = draws[rows_b[:, None], col_idx]           # [B, K, 4]
@@ -189,12 +199,18 @@ def gen_sqrt_batched(alphas, n: int, seeds=None, *, prf_method: int,
 
     from .prf import prf_v
     rows = np.arange(r, dtype=np.uint32)
-    p1 = prf_v(prf_method,
-               np.ascontiguousarray(np.broadcast_to(
-                   s1v[:, None, :], (bsz, r, 4))), rows)
-    p2 = prf_v(prf_method,
-               np.ascontiguousarray(np.broadcast_to(
-                   s2v[:, None, :], (bsz, r, 4))), rows)
+    if kn.get("prf_group") == "stacked":
+        both = prf_v(prf_method, np.ascontiguousarray(np.broadcast_to(
+            np.stack([s1v, s2v])[:, :, None, :],
+            (2, bsz, r, 4))).reshape(2 * bsz, r, 4), rows)
+        p1, p2 = both[:bsz], both[bsz:]
+    else:
+        p1 = prf_v(prf_method,
+                   np.ascontiguousarray(np.broadcast_to(
+                       s1v[:, None, :], (bsz, r, 4))), rows)
+        p2 = prf_v(prf_method,
+                   np.ascontiguousarray(np.broadcast_to(
+                       s2v[:, None, :], (bsz, r, 4))), rows)
     diff = u128.sub128(p1, p2)                        # [B, R, 4]
     beta_c = np.broadcast_to(u128.int_to_limbs(beta), (bsz, 4))
     tmask = (rows[None, :] == r_t[:, None])[..., None]

@@ -385,6 +385,29 @@ def default_registry() -> MetricsRegistry:
     return REGISTRY
 
 
+def observe_keygen(construction: str, batch: int, seconds: float,
+                   registry: MetricsRegistry | None = None) -> None:
+    """Record one batched-keygen call: keys produced and wall seconds,
+    labeled by ``construction`` ("logn.r2" / "logn.r4" / "sqrtn.r2")
+    and the batch size.  ``DPF.gen_batch`` calls this on every batch so
+    keys/s per construction is derivable from any scrape
+    (``dpf_keygen_keys_total / dpf_keygen_seconds_sum``).  Cheap and
+    exception-free by the registry's create-or-return semantics."""
+    reg = registry or REGISTRY
+    labels = {"construction": str(construction), "batch": int(batch)}
+    reg.counter(
+        "dpf_keygen_keys",
+        "DPF keys generated by batched keygen").labels(**labels).inc(
+            int(batch))
+    reg.counter(
+        "dpf_keygen_batches",
+        "Batched keygen calls").labels(**labels).inc()
+    reg.histogram(
+        "dpf_keygen_seconds",
+        "Batched keygen wall time per call (s)").labels(
+            **labels).observe(float(seconds))
+
+
 # ----------------------------------------------- first-class exporters
 
 #: EngineCounters fields exported per engine (counter semantics)

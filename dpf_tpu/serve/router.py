@@ -440,9 +440,10 @@ class SchemeRouter:
         resolver reports them — ``kernel_resolved_from`` provenance
         ("searched" for a tune/kernel_search variant) and
         ``row_chunk_effective`` (the chunk the Pallas grid kernel will
-        actually run after its VMEM cell cap; surfacing it on route
-        events is what keeps a halved chunk from being an invisible
-        different kernel than the cache entry claims).  Empty dict when
+        actually run after its VMEM cell cap) / ``chunk_leaves_effective``
+        (the GGM chunk after the live-seed budget clamp; surfacing them
+        on route events is what keeps a clamped chunk from being an
+        invisible different kernel than the cache entry claims).  Empty dict when
         the server doesn't expose a resolution.  Cheap:
         ``resolved_eval_knobs`` memoizes its tuning lookup per batch
         size."""
@@ -454,7 +455,8 @@ class SchemeRouter:
                 kn = rk(bucket)
                 info = {"kernel_impl": kn.get("kernel_impl")}
                 for extra in ("kernel_resolved_from",
-                              "row_chunk_effective"):
+                              "row_chunk_effective",
+                              "chunk_leaves_effective"):
                     if kn.get(extra) is not None:
                         info[extra] = kn[extra]
                 return info
@@ -538,7 +540,8 @@ class SchemeRouter:
                   "costs_ms": {lb: (None if c is None
                                     else round(c * 1e3, 4))
                                for lb, c in costs.items()}}
-            for extra in ("kernel_resolved_from", "row_chunk_effective"):
+            for extra in ("kernel_resolved_from", "row_chunk_effective",
+                          "chunk_leaves_effective"):
                 if kinfo.get(extra) is not None:
                     ev[extra] = kinfo[extra]
             if self.injector is not None:

@@ -214,20 +214,45 @@ def lookup_mesh_knobs(*, n: int, entry_size: int, batch: int,
 
 
 def lookup_kernel_variant(*, n: int, entry_size: int, batch: int,
-                          prf_method: int) -> dict | None:
-    """The searched kernel-variant knobs for this sqrtn shape on this
-    machine (``{"kernel_impl": ..., "row_chunk": ..., "dot_impl": ...,
-    "kernel_variant": {...}}``), recorded by ``benchmark.py
-    --autotune-kernel`` (``tune.kernel_search``) under the ``kvariant``
-    entry kind — a NEW kind, so pre-variant ``tuning.json`` files have
-    no such entries and this lookup is simply a miss on them.
-    Nearest-batch fallback like the eval-knob lookup.  Never raises."""
+                          prf_method: int, scheme: str = "sqrtn",
+                          radix: int = 2) -> dict | None:
+    """The searched kernel-variant knobs for this shape on this machine
+    (``{"kernel_impl": ..., "kernel_variant": {...}, ...}``), recorded
+    by ``benchmark.py --autotune-kernel`` (``tune.kernel_search``) under
+    the ``kvariant`` entry kind — a NEW kind, so pre-variant
+    ``tuning.json`` files have no such entries and this lookup is simply
+    a miss on them.  ``scheme``/``radix`` select the searched family's
+    construction (sqrt-N entries under scheme="sqrtn", GGM/log-N entries
+    under scheme="logn" with their radix) — the defaults preserve the
+    pre-family call shape.  Nearest-batch fallback like the eval-knob
+    lookup.  Never raises."""
     try:
         return default_cache().lookup_knobs(
             "kvariant", nearest_batch=True, n=n, entry_size=entry_size,
-            batch=batch, prf_method=prf_method, scheme="sqrtn", radix=2)
+            batch=batch, prf_method=prf_method, scheme=scheme,
+            radix=radix)
     except Exception as e:  # pragma: no cover — never break serving
         note_swallowed("tune.cache.lookup_kernel_variant", e)
+        return None
+
+
+def lookup_keygen_variant(*, n: int, batch: int, prf_method: int,
+                          scheme: str = "logn",
+                          radix: int = 2) -> dict | None:
+    """The searched batched-keygen knobs for this shape on this machine
+    (``{"keygen_knobs": {...}, "kernel_variant": {...}}``), recorded by
+    ``benchmark.py --autotune-kernel --family=keygen``
+    (``tune.kernel_search.keygen_search``).  Keygen cost is independent
+    of the table entry size, so these entries are keyed with the
+    ``entry_size=0`` sentinel — disjoint from the eval-side kvariant
+    entries at the same (n, batch).  Never raises."""
+    try:
+        return default_cache().lookup_knobs(
+            "kvariant", nearest_batch=True, n=n, entry_size=0,
+            batch=batch, prf_method=prf_method, scheme=scheme,
+            radix=radix)
+    except Exception as e:  # pragma: no cover — never break serving
+        note_swallowed("tune.cache.lookup_keygen_variant", e)
         return None
 
 

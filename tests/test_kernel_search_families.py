@@ -1,0 +1,387 @@
+"""Family-axis generative kernel search (log-N/GGM + batched keygen):
+variant grammar round-trips and validity rules for the two new
+families, the ``--family`` flag parser, f_levels bit-parity on the
+binary and mixed-radix expansion paths, keygen-knob bit-identity
+against the scalar generators across all three constructions,
+end-to-end ``kernel_search_ggm`` / ``keygen_search`` persistence and
+consumption (searched GGM knobs riding a logn dispatch with
+provenance, keygen knobs riding ``DPF.gen_batch``), the surfaced
+``chunk_leaves`` clamp, pre-family cache-entry riding rules, and the
+``dpf_keygen_*`` observability counters."""
+
+import json
+import importlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import dpf_tpu
+from dpf_tpu.core import expand, keygen, prf_ref, radix4, sqrtn
+from dpf_tpu.obs.metrics import MetricsRegistry, observe_keygen
+from dpf_tpu.tune import cache as tcache
+from dpf_tpu.tune.fingerprint import cache_key
+from dpf_tpu.utils.profiling import SWALLOWED_ERRORS
+
+# the package re-exports the kernel_search FUNCTION under the same
+# name; the tests need the module
+ks = importlib.import_module("dpf_tpu.tune.kernel_search")
+
+PRF = prf_ref.PRF_CHACHA20
+
+
+def _fresh_cache(tmp_path, monkeypatch):
+    monkeypatch.setenv("DPF_TPU_TUNE_CACHE", str(tmp_path / "t.json"))
+    return tcache.default_cache(refresh=True)
+
+
+# ------------------------------------------------------ variant grammar
+
+
+def test_ggm_variant_round_trip_and_knobs():
+    """to_dict/from_dict is the identity on every GGM field, tags are
+    engine-shaped, and eval_knobs() carries the logn knob surface."""
+    fused = ks.KernelVariant(family="ggm", engine="fused",
+                             chunk_leaves=128, f_levels=3, dot_impl="i32")
+    assert ks.KernelVariant.from_dict(fused.to_dict()) == fused
+    assert ks.KernelVariant.from_dict(
+        json.loads(json.dumps(fused.to_dict()))) == fused
+    kn = fused.eval_knobs()
+    assert kn["kernel_impl"] == "xla"
+    assert kn["chunk_leaves"] == 128 and kn["f_levels"] == 3
+    assert kn["kernel_variant"] == fused.to_dict()
+    assert fused.tag() == "g.f.c128.fl3.i32"
+
+    disp = ks.KernelVariant(family="ggm", engine="dispatch",
+                            chunk_leaves=64, dispatch_group=2,
+                            dot_impl="mxu")
+    assert disp.eval_knobs()["kernel_impl"] == "dispatch"
+    assert disp.eval_knobs()["dispatch_group"] == 2
+    assert disp.tag() == "g.d.c64.g2.mxu"
+
+    pl = ks.KernelVariant(family="ggm", engine="pallas", f_levels=4,
+                          tb=16)
+    assert pl.eval_knobs()["kernel_impl"] == "pallas"
+    assert pl.tag() == "g.p.fl4.tb16"
+
+
+def test_keygen_variant_round_trip_and_knobs():
+    """keygen variants serialize, tag, and expose exactly the knobs=
+    dict the batched generators take; they carry NO eval knobs (and a
+    non-keygen variant carries no keygen knobs)."""
+    v = ks.KernelVariant(family="keygen", prf_group="stacked",
+                         path_reuse="reuse", squeeze_draws=4)
+    assert ks.KernelVariant.from_dict(
+        json.loads(json.dumps(v.to_dict()))) == v
+    assert v.keygen_knobs() == {"prf_group": "stacked",
+                                "path_reuse": "reuse",
+                                "squeeze_draws": 4}
+    assert v.tag() == "k.stacked.reuse.sq4"
+    base = ks.KernelVariant(family="keygen")
+    assert base.keygen_knobs() == {}          # the PR-4 baseline
+    assert base.tag() == "k.pair.walk.sqall"
+    with pytest.raises(ValueError):
+        v.eval_knobs()
+    with pytest.raises(ValueError):
+        ks.KernelVariant(family="xla", row_chunk=4).keygen_knobs()
+
+
+def test_ggm_variant_invalid_rules():
+    n, batch = 1024, 8
+
+    def bad(**kw):
+        return ks.variant_invalid(ks.KernelVariant(family="ggm", **kw),
+                                  n=n, batch=batch, prf_method=PRF)
+
+    assert bad(engine="fused", chunk_leaves=256, dot_impl="i32") is None
+    assert bad(engine="dispatch", chunk_leaves=256,
+               dispatch_group=2) is None
+    assert bad(engine="turbo")                       # unknown engine
+    assert bad(engine="dispatch", f_levels=3)        # dispatch: no fl
+    assert bad(engine="fused", dispatch_group=2)     # fused: no group
+    assert bad(engine="fused", chunk_leaves=96)      # not a power of 2
+    assert bad(engine="fused", chunk_leaves=2048)    # > n
+    # a fused f_levels must come from the legal frontier set
+    cands = expand.f_level_candidates(n, 256, batch)
+    assert bad(engine="fused", chunk_leaves=256,
+               f_levels=cands[0]) is None
+    assert bad(engine="fused", chunk_leaves=256, f_levels=1)
+    # pallas engine: fl bounded by depth-3 and PALLAS_MAX_C, tb % 8
+    assert bad(engine="pallas", f_levels=4, tb=16) is None
+    assert bad(engine="pallas", f_levels=9)          # > depth-3
+    assert bad(engine="pallas", f_levels=4, tb=12)   # tb not mult of 8
+    assert bad(engine="pallas", f_levels=4,
+               tb=16) != bad(engine="pallas", f_levels=4, tb=12)
+    # pallas engine needs a plane/block core (no AES, no dummy)
+    v = ks.KernelVariant(family="ggm", engine="pallas", f_levels=4)
+    assert ks.variant_invalid(v, n=n, batch=batch,
+                              prf_method=prf_ref.PRF_AES128)
+
+
+def test_keygen_variant_invalid_rules():
+    def bad(**kw):
+        return ks.variant_invalid(ks.KernelVariant(family="keygen", **kw),
+                                  n=256, batch=8, prf_method=PRF)
+
+    assert bad() is None
+    assert bad(prf_group="stacked", path_reuse="reuse",
+               squeeze_draws=4) is None
+    assert bad(prf_group="bogus")
+    assert bad(path_reuse="bogus")
+    assert bad(squeeze_draws=0)
+    assert bad(squeeze_draws=True)                   # bool is not a count
+
+
+def test_sweep_families_parsing():
+    assert ks._sweep_families("all") == ("sqrtn", "logn", "keygen")
+    assert ks._sweep_families("sqrtn") == ("sqrtn",)
+    assert ks._sweep_families("logn,keygen") == ("logn", "keygen")
+    assert ks._sweep_families("keygen, keygen") == ("keygen",)
+    with pytest.raises(ValueError):
+        ks._sweep_families("ggm")                    # family is "logn"
+
+
+# --------------------------------------------------- f_levels parity
+
+
+def _r2_keys(n, n_keys):
+    flat = [keygen.generate_keys((i * 131) % n, n, b"kfl%d" % i, PRF)[0]
+            for i in range(n_keys)]
+    return expand.pack_keys(flat)
+
+
+def test_f_levels_bit_parity_binary():
+    """Every legal f_levels override of the fused r2 scan is
+    bit-identical to the chunk-implied default split."""
+    n, c, batch = 1024, 256, 4
+    depth = int(np.log2(n))
+    cw1, cw2, last = _r2_keys(n, batch)
+    table = np.random.default_rng(7).integers(
+        -2 ** 31, 2 ** 31, (n, 8), dtype=np.int32)
+    tperm = jnp.asarray(expand.permute_table(table))
+    want = np.asarray(expand.expand_and_contract(
+        cw1, cw2, last, tperm, depth=depth, prf_method=PRF,
+        chunk_leaves=c))
+    cands = expand.f_level_candidates(n, c, batch)
+    assert int(np.log2(n // c)) in cands             # default is a member
+    assert len(cands) > 1                            # space is non-trivial
+    for fl in cands:
+        got = np.asarray(expand.expand_and_contract(
+            cw1, cw2, last, tperm, depth=depth, prf_method=PRF,
+            chunk_leaves=c, f_levels=fl))
+        assert np.array_equal(got, want), "f_levels=%d" % fl
+
+
+def test_f_levels_bit_parity_mixed_radix():
+    """Every mixed-level split of the radix-4 path is bit-identical;
+    out-of-range overrides raise instead of silently corrupting."""
+    n, batch = 256, 3
+    ars = radix4.arities(n)
+    mk = [radix4.generate_keys_r4((i * 97) % n, n, b"kfm%d" % i, PRF)[0]
+          for i in range(batch)]
+    cw1, cw2, last = radix4.pack_mixed_keys(mk)
+    table = np.random.default_rng(9).integers(
+        -2 ** 31, 2 ** 31, (n, 8), dtype=np.int32)
+    perm = radix4.mixed_reverse_indices(ars)
+    tperm = jnp.asarray(np.ascontiguousarray(table[perm]))
+    want = np.asarray(radix4.expand_and_contract_mixed(
+        cw1, cw2, last, tperm, n=n, prf_method=PRF, chunk_leaves=None))
+    for fl in range(len(ars)):
+        got = np.asarray(radix4.expand_and_contract_mixed(
+            cw1, cw2, last, tperm, n=n, prf_method=PRF,
+            chunk_leaves=None, f_levels=fl))
+        assert np.array_equal(got, want), "f_levels=%d" % fl
+    with pytest.raises(ValueError):
+        radix4.expand_and_contract_mixed(
+            cw1, cw2, last, tperm, n=n, prf_method=PRF,
+            chunk_leaves=None, f_levels=len(ars))
+
+
+# --------------------------------------------- keygen knob bit-identity
+
+
+KNOB_SETS = [{"prf_group": "stacked"}, {"path_reuse": "reuse"},
+             {"squeeze_draws": 4},
+             {"prf_group": "stacked", "path_reuse": "reuse",
+              "squeeze_draws": 4}]
+
+
+@pytest.mark.parametrize("knobs", KNOB_SETS)
+def test_keygen_knobs_bit_identical_all_constructions(knobs):
+    """Every keygen knob is a schedule change, never a wire change:
+    knobbed batched output == baseline batched output, per construction,
+    both servers."""
+    n, batch = 256, 5
+    alphas = np.array([(i * 37) % n for i in range(batch)])
+    seeds = [b"kgi-%03d-" % i + bytes(8) for i in range(batch)]
+    for gen in (keygen.gen_batched, radix4.gen_batched_r4,
+                sqrtn.gen_sqrt_batched):
+        base = gen(alphas, n, seeds, prf_method=PRF)
+        got = gen(alphas, n, seeds, prf_method=PRF, knobs=knobs)
+        assert np.array_equal(got[0], base[0]), (gen.__name__, knobs)
+        assert np.array_equal(got[1], base[1]), (gen.__name__, knobs)
+
+
+# --------------------------------- search, persistence, consumption
+
+
+def test_kernel_search_ggm_persists_and_resolves(tmp_path, monkeypatch):
+    """End-to-end GGM search: 0 rejections / 0 gate escapes, winner
+    never regresses its seeds, Pallas variants are parity-pinned (not
+    timed off-TPU), the entry persists under scheme="logn", and an
+    all-auto logn DPF resolves it with provenance "searched" while
+    staying bit-exact against the CPU oracle."""
+    _fresh_cache(tmp_path, monkeypatch)
+    n, batch = 256, 4
+    rec = ks.kernel_search_ggm(n, batch, prf_method=PRF, reps=1,
+                               generations=2, population=3, distinct=4)
+    assert rec["searched"] is True and rec["gated"] is True
+    m = rec["measured"]
+    assert m["rejected"] == 0 and m["gate_escapes"] == 0
+    assert all(p["parity"] for p in rec["pallas_pinned"])
+    assert m["pallas_timed"] is False                # CPU host
+    assert m["best_s"] <= (m["seed_s"] or np.inf) + 1e-12
+    assert m["best_s"] <= (m["heuristic_s"] or np.inf) + 1e-12
+    assert rec["knobs"]["kernel_variant"]["family"] == "ggm"
+
+    # warm re-search answers from the cache without measuring
+    again = ks.kernel_search_ggm(n, batch, prf_method=PRF, reps=1,
+                                 generations=2, population=3, distinct=4)
+    assert again["searched"] is False
+    assert again["knobs"] == rec["knobs"]
+
+    dpf = dpf_tpu.DPF(prf=PRF)                       # logn r2, all-auto
+    table = np.random.default_rng(5).integers(
+        0, 2 ** 31, (n, 16), dtype=np.int32, endpoint=False)
+    dpf.eval_init(table)
+    kn = dpf.resolved_eval_knobs(batch)
+    assert kn["kernel_resolved_from"] == "searched"
+    assert kn["kernel_variant"] == rec["knobs"]["kernel_variant"]
+    keys = [dpf.gen((i * 31) % n, n)[0] for i in range(batch)]
+    assert np.array_equal(np.asarray(dpf.eval_tpu(keys)),
+                          np.asarray(dpf.eval_cpu(keys)))
+    # the logn entry never rides a sqrtn dispatch at the same shape
+    dsq = dpf_tpu.DPF(prf=PRF, scheme="sqrtn")
+    dsq.eval_init(table)
+    assert dsq.resolved_eval_knobs(batch)["kernel_resolved_from"] \
+        != "searched"
+
+
+def test_keygen_search_persists_and_gen_batch_rides(tmp_path,
+                                                    monkeypatch):
+    """End-to-end keygen search: fitness is keys/s with the PR-4
+    baseline always in the population, the gate is serialized-wire
+    equality against the scalar generator (0 escapes), the entry
+    persists under the entry_size=0 sentinel, and DPF.gen_batch
+    resolves exactly the winner's knobs while staying bit-identical
+    per key."""
+    cache = _fresh_cache(tmp_path, monkeypatch)
+    n, batch = 256, 8
+    rec = ks.keygen_search(n, batch, prf_method=PRF, reps=1,
+                           generations=2, population=4)
+    assert rec["searched"] is True and rec["gated"] is True
+    m = rec["measured"]
+    assert m["rejected"] == 0 and m["gate_escapes"] == 0
+    assert m["construction"] == "logn.r2"
+    assert m["keys_per_s"] >= m["baseline_keys_per_s"] > 0
+    assert rec["pallas_pinned"] == [] and m["pallas_timed"] is False
+    assert rec["knobs"]["kernel_variant"]["family"] == "keygen"
+
+    stored = cache.lookup(cache_key(
+        ks.VARIANT_KIND, n=n, entry_size=0, batch=batch,
+        prf_method=PRF, scheme="logn", radix=2))
+    assert stored is not None
+    assert stored["knobs"]["keygen_knobs"] == rec["knobs"]["keygen_knobs"]
+
+    dpf = dpf_tpu.DPF(prf=PRF)
+    resolved = dpf._resolved_keygen_knobs(n, batch)
+    assert resolved == (rec["knobs"]["keygen_knobs"] or None)
+    idx = np.array([(i * 31) % n for i in range(batch)])
+    seeds = [b"kgr-%03d-" % i + bytes(8) for i in range(batch)]
+    wa, wb = dpf.gen_batch(idx, n, seeds=seeds)
+    for i in range(batch):
+        ka, kb = dpf.gen(int(idx[i]), n, seed=seeds[i])
+        assert np.array_equal(np.asarray(wa[i]), np.asarray(ka))
+        assert np.array_equal(np.asarray(wb[i]), np.asarray(kb))
+
+
+def test_chunk_leaves_clamp_surfaced(tmp_path, monkeypatch):
+    """Satellite: a searched chunk_leaves that the live-seed budget
+    clamps (nearest-batch fallback pairing a small-batch chunk with a
+    big batch) is surfaced — chunk_leaves_effective in the resolution
+    and a count at api.chunk_leaves_clamped — never silently swallowed."""
+    _fresh_cache(tmp_path, monkeypatch)
+    n, batch = 1 << 20, 32                           # budget caps C < n
+    v = ks.KernelVariant(family="ggm", engine="fused", chunk_leaves=n,
+                         dot_impl="i32")
+    dpf = dpf_tpu.DPF(prf=PRF)
+    dpf.eval_init(np.zeros((n, 1), np.int32))
+    dpf._tuned_cache[dpf._pow2_domain(batch)] = {
+        "_searched": v.eval_knobs()}
+    before = sum(SWALLOWED_ERRORS.get("api.chunk_leaves_clamped",
+                                      {}).values())
+    kn = dpf.resolved_eval_knobs(batch)
+    assert kn["kernel_resolved_from"] == "searched"
+    assert kn["chunk_leaves"] < n
+    assert kn["chunk_leaves_effective"] == kn["chunk_leaves"]
+    after = sum(SWALLOWED_ERRORS.get("api.chunk_leaves_clamped",
+                                     {}).values())
+    assert after == before + 1
+    # an unclamped resolution does NOT report an effective chunk
+    dpf2 = dpf_tpu.DPF(prf=PRF)
+    dpf2.eval_init(np.zeros((256, 1), np.int32))
+    assert "chunk_leaves_effective" not in dpf2.resolved_eval_knobs(4)
+
+
+def test_pre_family_entry_rides_sqrtn_only(tmp_path, monkeypatch):
+    """Backward compat: a PR-15 (pre-family-axis) kvariant entry still
+    parses and resolves as the sqrt-N family — and never rides a logn
+    dispatch or a gen_batch keygen call."""
+    cache = _fresh_cache(tmp_path, monkeypatch)
+    n, batch = 256, 8
+    # the pre-family grammar: sqrtn-keyed, xla variant, no engine/
+    # keygen fields anywhere
+    cache.store(
+        cache_key(ks.VARIANT_KIND, n=n, entry_size=16, batch=batch,
+                  prf_method=PRF, scheme="sqrtn", radix=2),
+        {"knobs": {"kernel_impl": "xla", "row_chunk": 4,
+                   "dot_impl": "i32",
+                   "kernel_variant": {"family": "xla", "row_chunk": 4,
+                                      "dot_impl": "i32"}}})
+    table = np.random.default_rng(5).integers(
+        0, 2 ** 31, (n, 16), dtype=np.int32, endpoint=False)
+    dsq = dpf_tpu.DPF(prf=PRF, scheme="sqrtn")
+    dsq.eval_init(table)
+    kn = dsq.resolved_eval_knobs(batch)
+    assert kn["kernel_resolved_from"] == "searched"
+    assert kn["kernel_variant"]["family"] == "xla"
+    # same shape, logn construction: the sqrtn entry must not ride
+    dln = dpf_tpu.DPF(prf=PRF)
+    dln.eval_init(table)
+    assert dln.resolved_eval_knobs(batch)["kernel_resolved_from"] \
+        != "searched"
+    # and it is not a keygen entry either
+    assert dsq._resolved_keygen_knobs(n, batch) is None
+    assert tcache.lookup_keygen_variant(n=n, batch=batch,
+                                        prf_method=PRF,
+                                        scheme="sqrtn", radix=2) is None
+
+
+# ------------------------------------------------------- observability
+
+
+def test_observe_keygen_metrics():
+    """dpf_keygen_* counters/histogram accumulate under (construction,
+    batch) labels and never raise."""
+    reg = MetricsRegistry()
+    observe_keygen("logn.r2", 8, 0.25, registry=reg)
+    observe_keygen("logn.r2", 8, 0.25, registry=reg)
+    observe_keygen("sqrtn.r2", 4, 0.1, registry=reg)
+    lab = {"construction": "logn.r2", "batch": 8}
+    assert reg.counter("dpf_keygen_keys").labels(**lab).value == 16
+    assert reg.counter("dpf_keygen_batches").labels(**lab).value == 2
+    assert reg.counter("dpf_keygen_keys").labels(
+        construction="sqrtn.r2", batch=4).value == 4
+    text = reg.openmetrics()
+    assert "dpf_keygen_seconds" in text
