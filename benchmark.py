@@ -18,6 +18,7 @@
 #   --multihost        serve/bench_multihost.py     MULTIHOST_r14.json
 #   --multitenant      serve/bench_multitenant.py   MULTITENANT_r16.json
 #   --plan             plan/bench_plan.py           PLAN_r17.json
+#   --bigtable         serve/bench_bigtable.py      BIGTABLE_r19.json
 #
 # --serve: streaming serving benchmark (blocking loop vs pipelined
 # ServingEngine).  See docs/SERVING.md.
@@ -101,6 +102,18 @@
 # death vs the static peak fleet on engine-hours) and against real
 # ServingEngine replicas; --dryrun is the seconds-long CI smoke.  See
 # docs/PLANNING.md.
+#
+# --bigtable: the billion-row table tier — hosts ASSIGNED more table
+# bytes than their device budget (granule-level paging through
+# serve/registry.GranuleStore, every merged answer bit-gated against
+# the scalar oracle), prefetch-on vs prefetch-off p99 under periodic
+# residency pressure, the 2D row x entry-byte mesh programs
+# (parallel/sharded.eval_sharded_2d) gated against the 1D path and
+# the single-chip oracle on the forced 8-device CPU mesh, and
+# memory-aware fleet planning (plan_fleet with a binding HBM floor +
+# the twin's paging-stall fidelity legs); --dryrun is the seconds-long
+# CI smoke.  See docs/SHARDING.md "2D sharding" and docs/PLANNING.md
+# "Memory-aware planning".
 #
 # --trace: end-to-end observability — span tracing over the serving
 # path with a joint host+device digest for one tuned shape, the
@@ -226,6 +239,12 @@ if __name__ == "__main__":
         # an environment whose jax state the parent has not finalized
         from dpf_tpu.serve.bench_multihost import main
         main([a for a in sys.argv[1:] if a != "--multihost"])
+        sys.exit(0)
+    if "--bigtable" in sys.argv:
+        # also before any backend touch: the 2D mesh leg forces the
+        # virtual 8-device CPU mesh first (utils/hermetic.py)
+        from dpf_tpu.serve.bench_bigtable import main
+        main([a for a in sys.argv[1:] if a != "--bigtable"])
         sys.exit(0)
     if "--batch-pir" in sys.argv:
         from dpf_tpu.serve.bench_pir import main

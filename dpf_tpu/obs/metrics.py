@@ -588,6 +588,40 @@ def register_table_registry(registry_obj,
     reg.watch(registry_obj, emit)
 
 
+def register_granule_store(store_obj,
+                           registry: MetricsRegistry | None = None):
+    """Export a ``serve.registry.GranuleStore``'s granule-level
+    residency state — the resident-granule gauge and the promotion/
+    demotion/prefetch counters — as ``dpf_registry_granule*{store=...}``
+    series (weakly held).  The granule-id detail rides the FLIGHT
+    ``registry`` events (``granule=row0``); metrics carry the
+    aggregate."""
+    reg = registry or REGISTRY
+
+    def emit(s):
+        out = []
+        st = s.stats()
+        lbl = {"store": st["name"]}
+        out.append(("dpf_registry_granules_resident", "gauge",
+                    "granules currently device-resident", lbl,
+                    float(st["granules_resident"])))
+        out.append(("dpf_registry_granule_resident_bytes", "gauge",
+                    "device bytes resident at granule grain", lbl,
+                    float(st["resident_bytes"])))
+        if st["budget_bytes"] is not None:
+            out.append(("dpf_registry_granule_budget_bytes", "gauge",
+                        "configured granule-residency byte budget", lbl,
+                        float(st["budget_bytes"])))
+        for f in ("promotions", "demotions", "evictions",
+                  "deferred_demotions", "hits", "misses", "prefetches",
+                  "prefetch_hits", "prefetch_misses", "overcommits"):
+            out.append(("dpf_registry_granule_" + f, "counter",
+                        "GranuleStore residency counter", lbl,
+                        float(st["counters"][f])))
+        return [(n, k, h, _with_process(l), v) for n, k, h, l, v in out]
+    reg.watch(store_obj, emit)
+
+
 def register_tenants(tenant_router,
                      registry: MetricsRegistry | None = None):
     """Export a ``serve.tenant.TenantRouter``'s scheduler state — queue

@@ -133,13 +133,25 @@ class ClusterShardServer:
     holds) and sums the partials on device, still async.
     ``add_granules`` is the recovery hook: a ``device_put`` per new
     granule, no recompilation.
+
+    ``budget_bytes`` switches the host to PAGED residency (the
+    big-table tier): granules live in a ``serve.registry.GranuleStore``
+    instead of pinned device buffers, so the host can be ASSIGNED more
+    table bytes than its device budget holds.  A dispatch then walks
+    its assignment leasing each granule (demand-promoting cold ones
+    through the same ``device_put`` path — bit-identical bytes), and
+    issues a free-budget prefetch of the NEXT granule before each
+    eval so page-in overlaps the in-flight async compute.  Recovery is
+    unchanged: ``add_granules`` on a paged host just extends the
+    assignment — faulted-in granules page up on first dispatch.
     """
 
     scheme = "logn"
 
     def __init__(self, table_perm: np.ndarray, row0s, granule: int, *,
                  prf_method: int, batch_size: int = 512,
-                 aes_impl: str | None = None):
+                 aes_impl: str | None = None,
+                 budget_bytes: int | None = None):
         import jax.numpy as jnp
         if table_perm.ndim != 2:
             raise ValueError("table_perm must be [n, entry_size]")
@@ -151,15 +163,31 @@ class ClusterShardServer:
         self.prf_method = int(prf_method)
         self.batch_size = int(batch_size)
         self.aes_impl = aes_impl
+        self.budget_bytes = (None if budget_bytes is None
+                             else int(budget_bytes))
         self._shards = []                      # [(row0, device [g, E])]
+        self._assigned = []                    # paged mode: row0 list
+        self.store = None                      # paged mode: GranuleStore
+        if self.budget_bytes is not None:
+            from ..serve.registry import GranuleStore
+            self.store = GranuleStore(table_perm, self.granule,
+                                      budget_bytes=self.budget_bytes)
         self.add_granules(row0s)
+
+    @property
+    def paged(self) -> bool:
+        return self.store is not None
 
     def add_granules(self, row0s) -> None:
         """Upload granules [row0, row0+granule) (recovery/reshard
         entry point — device transfer only, the jitted program for this
-        granule shape is shared with every other granule)."""
+        granule shape is shared with every other granule).  On a paged
+        host this only extends the ASSIGNMENT: the granule pages up at
+        its first dispatch (or prefetch) instead of eagerly, so a
+        recovery reshard never blows the device budget."""
         import jax
-        held = {r for r, _ in self._shards}
+        held = (set(self._assigned) if self.paged
+                else {r for r, _ in self._shards})
         for row0 in row0s:
             row0 = int(row0)
             if row0 % self.granule or not 0 <= row0 < self.n:
@@ -167,10 +195,14 @@ class ClusterShardServer:
                                  % (row0, self.granule))
             if row0 in held:
                 continue
-            sl = self._table_perm[row0:row0 + self.granule]
-            self._shards.append((row0, jax.device_put(sl)))
+            if self.paged:
+                self._assigned.append(row0)
+            else:
+                sl = self._table_perm[row0:row0 + self.granule]
+                self._shards.append((row0, jax.device_put(sl)))
             held.add(row0)
         self._shards.sort(key=lambda t: t[0])
+        self._assigned.sort()
 
     def set_granules(self, row0s) -> None:
         """Replace the held granules wholesale (hot-standby promotion:
@@ -178,10 +210,15 @@ class ClusterShardServer:
         dead host's real granules — same traced shape, so still no
         recompilation)."""
         self._shards = []
+        if self.paged:
+            self._assigned = []
+            self.store.demote_all()
         self.add_granules(row0s)
 
     @property
     def granules(self) -> tuple:
+        if self.paged:
+            return tuple(self._assigned)
         return tuple(r for r, _ in self._shards)
 
     def _decode_batch(self, keys) -> keygen.PackedKeys:
@@ -196,18 +233,40 @@ class ClusterShardServer:
 
     def _dispatch_packed(self, pk: keygen.PackedKeys):
         """Sum of this host's granule partials ([B, E] int32, device,
-        async).  Wrapping int32 adds keep additive-share semantics."""
-        if not self._shards:
-            raise RuntimeError("shard server holds no granules")
+        async).  Wrapping int32 adds keep additive-share semantics.
+
+        Paged mode walks the assignment in row0 order: lease (fault-in
+        when cold), dispatch the async partial eval, release, then
+        prefetch the NEXT granule into free budget — the page-in
+        ``device_put`` runs while the just-dispatched eval is still in
+        flight, which is the overlap that keeps paging off the
+        critical path."""
         from . import sharded
+        if not (self._assigned if self.paged else self._shards):
+            raise RuntimeError("shard server holds no granules")
         chunk = expand.clamp_chunk(0, self.granule, pk.batch)
-        out = None
-        for row0, tbl in self._shards:
+
+        def eval_one(row0, tbl, out):
             part = sharded.eval_leaf_range_local(
                 pk.cw1, pk.cw2, pk.last, tbl, row0, depth=pk.depth,
                 prf_method=self.prf_method, chunk_leaves=chunk,
                 n_total=self.n, aes_impl=self.aes_impl)
-            out = part if out is None else self._jnp.add(out, part)
+            return part if out is None else self._jnp.add(out, part)
+
+        if self.paged:
+            out = None
+            for i, row0 in enumerate(self._assigned):
+                lease = self.store.lease(row0)
+                try:
+                    out = eval_one(row0, lease.table, out)
+                finally:
+                    lease.release()
+                if i + 1 < len(self._assigned):
+                    self.store.prefetch(self._assigned[i + 1])
+            return out
+        out = None
+        for row0, tbl in self._shards:
+            out = eval_one(row0, tbl, out)
         return out
 
 
@@ -416,7 +475,8 @@ class ClusterRouter:
     @classmethod
     def local(cls, table, hosts: int = 2, *, prf_method=None,
               oracle=None, buckets=None, injector=None,
-              engine_kw=None, **router_kw) -> "ClusterRouter":
+              engine_kw=None, host_budget_bytes=None,
+              **router_kw) -> "ClusterRouter":
         """Build an all-in-process cluster over ``table`` — the
         simulation tier (tests, the ``--multihost`` bench's fallback
         mode) exercising the identical scatter/recovery state machine
@@ -425,7 +485,10 @@ class ClusterRouter:
         ``oracle`` (an ``api.DPF``) supplies ``prf_method`` when not
         given explicitly; consults the tuning cache for cluster scatter
         knobs (bucket ladder / in-flight window) unless ``buckets``
-        pins them.
+        pins them.  ``host_budget_bytes`` builds every host PAGED
+        (granule-level residency bounded to that device budget — the
+        big-table tier, where a host's assignment may exceed what its
+        device holds).
         """
         if prf_method is None:
             if oracle is not None:
@@ -456,7 +519,8 @@ class ClusterRouter:
                       key=lambda kv: int(kv[0][4:]))
         for i, (lb, row0s) in enumerate(plan):
             srv = ClusterShardServer(perm, row0s, g,
-                                     prf_method=prf_method)
+                                     prf_method=prf_method,
+                                     budget_bytes=host_budget_bytes)
             nodes.append(LocalHost(lb, srv, process_index=i,
                                    buckets=buckets, injector=injector,
                                    **kw))

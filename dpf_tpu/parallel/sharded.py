@@ -89,7 +89,8 @@ def _valid_psum_group(psum_group, n_chunks: int) -> int:
     return g if 0 < g < n_chunks and n_chunks % g == 0 else 0
 
 
-def _scan_psum_groups(body, zeros, xs, axis_name: str):
+def _scan_psum_groups(body, zeros, xs, axis_name: str,
+                      outer_axes=("batch",)):
     """Grouped-psum driver shared by the three sharded constructions.
 
     Scans ``xs`` (every leaf already reshaped to ``[n_groups, g, ...]``)
@@ -100,20 +101,22 @@ def _scan_psum_groups(body, zeros, xs, axis_name: str):
     no data dependency on the NEXT group's PRF expansion, so an async
     backend overlaps ICI latency with compute.
 
-    Carry typing: the INNER partial is varying over both mesh axes (its
-    body adds shard-local dot products), but the OUTER carry holds only
-    psum outputs — invariant along ``axis_name`` — so it is typed
-    varying over "batch" alone.  Typing it over both axes would trip
-    shard_map's out_specs invariance check on jaxlibs with varying
-    types (``lax.pvary`` present); on older jaxlibs both ``_pvary``
-    calls are identity.
-    """
+    Carry typing: the INNER partial is varying over ``outer_axes`` plus
+    ``axis_name`` (its body adds shard-local dot products), but the
+    OUTER carry holds only psum outputs — invariant along ``axis_name``
+    — so it is typed varying over ``outer_axes`` alone.  Typing it over
+    the reduced axis too would trip shard_map's out_specs invariance
+    check on jaxlibs with varying types (``lax.pvary`` present); on
+    older jaxlibs both ``_pvary`` calls are identity.  The 2D row x
+    entry-byte path passes ``outer_axes=("batch", "byte")``: its psum
+    runs over "table" only, so the carry still varies over the byte
+    axis (each byte shard holds a different entry block)."""
     def gbody(acc, xs_g):
-        part0 = _pvary(zeros, ("batch", axis_name))
+        part0 = _pvary(zeros, tuple(outer_axes) + (axis_name,))
         part, _ = jax.lax.scan(body, part0, xs_g)
         return acc + jax.lax.psum(part, axis_name), None
 
-    acc, _ = jax.lax.scan(gbody, _pvary(zeros, ("batch",)), xs)
+    acc, _ = jax.lax.scan(gbody, _pvary(zeros, tuple(outer_axes)), xs)
     return acc
 
 
@@ -154,6 +157,92 @@ def eval_sharded(cw1, cw2, last, table_perm, *, depth: int, prf_method: int,
         per_shard, mesh=mesh,
         in_specs=(P("batch"), P("batch"), P("batch"), P("table", None)),
         out_specs=P("batch", None))
+    return fn(cw1, cw2, last, table_perm)
+
+
+def make_mesh_2d(n_table: int | None = None, n_byte: int = 1,
+                 n_batch: int = 1, devices=None) -> Mesh:
+    """Build a ("batch", "table", "byte") mesh: rows x entry-bytes over
+    the host x chip grid.  ``n_byte=1`` degenerates to the 1D layout
+    (and ``fingerprint.mesh_tag`` then emits the pre-2D tag, so tuned
+    entries are shared).  Lay "table" on the ICI-adjacent dimension —
+    the per-chunk psum rides it; the "byte" all_gather fires once per
+    dispatch and tolerates the slower hops."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    if n_table is None:
+        n_table = devices.size // (n_batch * n_byte)
+    assert n_table * n_batch * n_byte == devices.size, \
+        "mesh axes (%d x %d x %d) must cover %d devices" % (
+            n_batch, n_table, n_byte, devices.size)
+    return Mesh(devices.reshape(n_batch, n_table, n_byte),
+                ("batch", "table", "byte"))
+
+
+def shard_table_2d(table_i32: np.ndarray, mesh: Mesh):
+    """Permute (bit-reversal) and block-shard a table over the
+    ("table", "byte") plane: each chip holds one ``[rows/n_table,
+    E/n_byte]`` block — contiguous BFS leaf rows x a contiguous slice
+    of entry columns (int32 words; "byte axis" names the role, the
+    unit is the table's column dtype).  This is what lets a table
+    larger than ONE chip's HBM spread over the whole grid: per-chip
+    bytes shrink by n_table x n_byte."""
+    perm = expand.permute_table(np.asarray(table_i32, dtype=np.int32))
+    if perm.shape[1] % mesh.shape["byte"]:
+        raise ValueError(
+            "entry columns (%d) must divide over %d byte shards"
+            % (perm.shape[1], mesh.shape["byte"]))
+    sharding = NamedSharding(mesh, P("table", "byte"))
+    return jax.device_put(jnp.asarray(perm), sharding)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("depth", "prf_method", "chunk_leaves",
+                                    "mesh", "aes_impl", "psum_group"))
+def eval_sharded_2d(cw1, cw2, last, table_perm, *, depth: int,
+                    prf_method: int, chunk_leaves: int, mesh: Mesh,
+                    aes_impl: str | None = None, psum_group: int = 0):
+    """Mesh-parallel fused DPF evaluation over a 2D row x entry-byte
+    table layout (``shard_table_2d``).
+
+    Each chip expands only its row shard's GGM subtrees (the PRF work
+    is replicated along the "byte" axis — byte shards of the same row
+    range need the same leaf bits) and contracts them against its
+    ``[rows_shard, e_shard]`` block.  Partials combine in a two-phase
+    reduction: (1) psum over "table" — blocks in one byte column cover
+    disjoint row ranges of the SAME entry columns, and additive int32
+    shares commute with partial dot products, so the sum is exact; with
+    ``psum_group`` the psum fires per chunk group and overlaps the next
+    group's PRF expansion exactly like the 1D path (the grouped carry
+    stays varying over "byte": ``_scan_psum_groups(outer_axes=("batch",
+    "byte"))``).  (2) concatenation along "byte" — byte shards hold
+    DIFFERENT entry columns, so they concatenate, they never sum; the
+    concat is expressed as the OUTPUT LAYOUT (``out_specs=P("batch",
+    "byte")``), which costs no collective at all: the global [B, E]
+    result is simply sharded over "byte" on the entry axis (and
+    replicated over "table"), and a consumer that needs it replicated
+    pays the gather on materialization."""
+    n_shards = mesh.shape["table"]
+    n = table_perm.shape[0]
+    shard_rows = n // n_shards
+    assert shard_rows * n_shards == n
+
+    def per_shard(cw1, cw2, last, tbl_block):
+        # tbl_block: [n/n_table, E/n_byte] — this chip's 2D block
+        shard_ix = jax.lax.axis_index("table")
+        out, psummed = _eval_leaf_range(
+            cw1, cw2, last, tbl_block, shard_ix * shard_rows,
+            depth=depth, prf_method=prf_method,
+            chunk_leaves=min(chunk_leaves, shard_rows),
+            n_total=n, aes_impl=aes_impl, psum_group=psum_group,
+            axis_name="table", carry_axes=("batch", "table", "byte"))
+        if not psummed:
+            out = jax.lax.psum(out, "table")
+        return out
+
+    fn = _shard_map(
+        per_shard, mesh=mesh,
+        in_specs=(P("batch"), P("batch"), P("batch"), P("table", "byte")),
+        out_specs=P("batch", "byte"))
     return fn(cw1, cw2, last, table_perm)
 
 
@@ -223,7 +312,8 @@ def _eval_leaf_range(cw1, cw2, last, tbl, row0, *, depth: int,
         return acc, False
     return _scan_psum_groups(body, zeros, (
         frontier.reshape(f_local // g, g, bsz, 4),
-        tbl_chunks.reshape(f_local // g, g, c, e)), axis_name), True
+        tbl_chunks.reshape(f_local // g, g, c, e)), axis_name,
+        outer_axes=tuple(a for a in carry_axes if a != axis_name)), True
 
 
 @functools.partial(jax.jit,
@@ -405,10 +495,18 @@ class ShardedDPFServer:
             raise ValueError(
                 "table rows (%d) must divide over %d table shards"
                 % (self.n, n_shards))
+        self.n_byte = dict(self.mesh.shape).get("byte", 1)
+        if self.n_byte > 1 and (self.scheme != "logn" or self.radix != 2):
+            raise ValueError(
+                "byte-axis (2D) sharding serves the binary GGM "
+                "construction only (scheme=%r radix=%d)"
+                % (self.scheme, self.radix))
         if self.scheme == "sqrtn":
             self.table_sharded = shard_table_sqrt(tbl, self.mesh)
         elif self.radix == 4:
             self.table_sharded = shard_table_mixed(tbl, self.mesh)
+        elif self.n_byte > 1:
+            self.table_sharded = shard_table_2d(tbl, self.mesh)
         else:
             self.table_sharded = shard_table(tbl, self.mesh)
         # the explicit knob layer: ctor args (None = auto); assigning
@@ -603,6 +701,13 @@ class ShardedDPFServer:
             return eval_sharded_mixed(
                 pk.cw1, pk.cw2, pk.last, self.table_sharded, n=self.n,
                 prf_method=self.prf_method,
+                chunk_leaves=kn["chunk_leaves"], mesh=self.mesh,
+                aes_impl=_prf._aes_pair_impl(),
+                psum_group=kn["psum_group"])
+        if self.n_byte > 1:
+            return eval_sharded_2d(
+                pk.cw1, pk.cw2, pk.last, self.table_sharded,
+                depth=self.depth, prf_method=self.prf_method,
                 chunk_leaves=kn["chunk_leaves"], mesh=self.mesh,
                 aes_impl=_prf._aes_pair_impl(),
                 psum_group=kn["psum_group"])

@@ -47,6 +47,44 @@ def _scale_trace(trace, factor: float) -> list:
     return out
 
 
+#: fallback per-host HBM byte budget when neither the caller nor the
+#: device probe supplies one (a mid-range accelerator host; the point
+#: of the default is a usable memory floor, not precision — real plans
+#: pass the probed or provisioned figure)
+DEFAULT_HBM_BYTES = 16 << 30
+
+
+def detect_hbm_budget(device=None) -> int | None:
+    """Per-host HBM byte budget probed from the local device
+    (``utils.compat.device_memory_stats`` -> ``bytes_limit``); None on
+    CPU/old-jax hosts, where there is no device ceiling to plan
+    around.  The only jax-adjacent call in the plan package — and it
+    stays import-lazy and failure-proof, so the planner itself remains
+    runnable with zero JAX dispatches."""
+    try:
+        from ..utils.compat import device_memory_stats
+        st = device_memory_stats(device)
+    except Exception:
+        return None
+    if not st:
+        return None
+    limit = st.get("bytes_limit") or st.get("bytes_reservable_limit")
+    return int(limit) if limit else None
+
+
+def min_hosts_for_memory(table_bytes: int,
+                         hbm_bytes_per_host: int) -> int:
+    """The memory floor: hosts needed just to HOLD ``table_bytes`` of
+    table at ``hbm_bytes_per_host`` each (the 2D/cluster tiers shard
+    the table across hosts, so fleet HBM is hosts x per-host budget).
+    Monotone in table bytes by construction (a ceil of a ratio)."""
+    if table_bytes < 0:
+        raise ValueError("table_bytes must be >= 0")
+    if hbm_bytes_per_host < 1:
+        raise ValueError("hbm_bytes_per_host must be >= 1")
+    return max(1, -(-int(table_bytes) // int(hbm_bytes_per_host)))
+
+
 @dataclasses.dataclass
 class PlanResult:
     """One planned point: the minimal passing fleet and its twin run."""
@@ -102,8 +140,9 @@ def required_replicas(trace, cost_table, *, label: str, slo_s: float,
 def plan_fleet(trace, cost_table, *, label: str, slo_s: float,
                load_scales=(0.5, 1.0, 1.5, 2.0), seed: int = 0,
                fleet_kw: dict | None = None, max_replicas: int = 16,
-               max_shed_rate: float = 0.0,
-               host_slots: int = 4) -> dict:
+               max_shed_rate: float = 0.0, host_slots: int = 4,
+               table_bytes: int | None = None,
+               hbm_bytes_per_host: int | None = None) -> dict:
     """The capacity plan: minimal fleet at the offered load plus the
     headroom curve over ``load_scales``.
 
@@ -111,11 +150,39 @@ def plan_fleet(trace, cost_table, *, label: str, slo_s: float,
     are the running max over ascending scales, so "more qps never
     plans fewer engines" holds for every emitted plan — any twin
     noise that would dip the curve is absorbed upward (conservative:
-    over-provisioning, never under)."""
+    over-provisioning, never under).
+
+    ``table_bytes`` makes HBM a first-class resource next to compute:
+    every curve point's ``hosts`` becomes ``max(throughput hosts,
+    memory-floor hosts)`` where the floor is
+    ``min_hosts_for_memory(table_bytes, hbm_bytes_per_host)`` — the
+    hosts needed just to HOLD the sharded table.  This answers "how
+    many hosts for a 10^9-row table at this qps" with a curve that is
+    JOINTLY monotone: nondecreasing in offered load (running max) and
+    nondecreasing in table bytes (a ceil of a ratio), because a max of
+    monotone terms is monotone.  ``hbm_bytes_per_host`` resolves
+    explicit > device probe (``detect_hbm_budget``) >
+    ``DEFAULT_HBM_BYTES``, with the provenance recorded."""
     if isinstance(cost_table, dict):
         cost_table = CostTable.from_dict(cost_table)
     fleet_kw = dict(fleet_kw or {})
     fleet_kw.setdefault("host_slots", host_slots)
+    memory = None
+    mem_hosts = 0
+    if table_bytes is not None:
+        if hbm_bytes_per_host is not None:
+            hbm, hbm_source = int(hbm_bytes_per_host), "explicit"
+        else:
+            hbm = detect_hbm_budget()
+            if hbm is not None:
+                hbm_source = "device"
+            else:
+                hbm, hbm_source = DEFAULT_HBM_BYTES, "default"
+        mem_hosts = min_hosts_for_memory(table_bytes, hbm)
+        memory = {"table_bytes": int(table_bytes),
+                  "hbm_bytes_per_host": hbm,
+                  "hbm_source": hbm_source,
+                  "hosts_memory_floor": mem_hosts}
     scales = sorted(set(float(s) for s in load_scales) | {1.0})
     curve = []
     running = 0
@@ -127,20 +194,24 @@ def plan_fleet(trace, cost_table, *, label: str, slo_s: float,
             max_shed_rate=max_shed_rate)
         planned = max(running, pr.replicas)
         running = planned
+        hosts_tp = -(-planned // int(fleet_kw["host_slots"]))
         curve.append({
             "load_scale": sc,
             "replicas": planned,
             "replicas_raw": pr.replicas,
-            "hosts": -(-planned // int(fleet_kw["host_slots"])),
+            "hosts": max(hosts_tp, mem_hosts),
+            "hosts_throughput": hosts_tp,
             "met_slo": pr.met_slo,
             "p99_ms": pr.summary["p99_ms"],
             "shed_rate": pr.summary["shed_rate"],
             "qps": pr.summary["qps"],
         })
     at_one = next(c for c in curve if c["load_scale"] == 1.0)
-    monotone = all(curve[i]["replicas"] <= curve[i + 1]["replicas"]
-                   for i in range(len(curve) - 1))
-    return {
+    monotone = all(
+        curve[i]["replicas"] <= curve[i + 1]["replicas"]
+        and curve[i]["hosts"] <= curve[i + 1]["hosts"]
+        for i in range(len(curve) - 1))
+    out = {
         "construction": label,
         "slo_ms": round(slo_s * 1e3, 3),
         "replicas": at_one["replicas"],
@@ -150,3 +221,6 @@ def plan_fleet(trace, cost_table, *, label: str, slo_s: float,
         "monotone": monotone,   # True by construction; recorded so the
         #                         gate can assert it from the record
     }
+    if memory is not None:
+        out["memory"] = memory
+    return out

@@ -205,6 +205,17 @@ class FleetConfig:
     rebuild_s: float | None = None
     spinup_s: float = 0.2
     host_slots: int = 4
+    # ---- HBM paging (the big-table tier): when a replica's share of
+    # the table exceeds its device budget, every full-domain dispatch
+    # must page the missing bytes host->device; the stall per dispatch
+    # is missing_bytes / page_gbps, discounted by prefetch_overlap
+    # (the fraction the GranulePrefetcher hides behind in-flight
+    # compute).  table_bytes=0 or hbm_bytes_per_replica=None = no
+    # paging modeled (the pre-bigtable behavior, field-for-field).
+    table_bytes: int = 0
+    hbm_bytes_per_replica: int | None = None
+    page_gbps: float = 8.0
+    prefetch_overlap: float = 0.0
 
     def __post_init__(self):
         self.replicas = {str(k): int(v)
@@ -219,6 +230,13 @@ class FleetConfig:
             raise ValueError("max_in_flight must be >= 1")
         if self.window < 1:
             raise ValueError("window must be >= 1")
+        if self.table_bytes < 0:
+            raise ValueError("table_bytes must be >= 0")
+        if self.page_gbps <= 0:
+            raise ValueError("page_gbps must be > 0")
+        if not 0 <= self.prefetch_overlap <= 1:
+            raise ValueError("prefetch_overlap must be in [0, 1] "
+                             "(got %r)" % (self.prefetch_overlap,))
 
     # -- the ~10 lines of serve/buckets.py the twin needs, standalone
     #    (parity-tested against the real Buckets in tests/test_plan.py)
@@ -247,6 +265,18 @@ class FleetConfig:
             lo += self.max_bucket
         spans.append((lo, b))
         return spans
+
+    def paging_stall_s(self) -> float:
+        """Host->device paging stall per full-domain dispatch (0.0
+        when paging is not modeled).  A full-domain eval touches every
+        table row, so the bytes that don't fit in the replica's device
+        budget must stream in on EVERY dispatch:
+        ``missing / page_gbps``, discounted by ``prefetch_overlap``."""
+        if self.table_bytes == 0 or self.hbm_bytes_per_replica is None:
+            return 0.0
+        missing = max(0, self.table_bytes - self.hbm_bytes_per_replica)
+        return (missing / (self.page_gbps * (1 << 30))
+                * (1.0 - self.prefetch_overlap))
 
     def total_replicas(self) -> int:
         return sum(self.replicas.values())
@@ -825,7 +855,7 @@ def _dispatch(rep: _SimReplica, batch: int, fleet: FleetConfig,
                     injector.firing(("latency",), label, size))
         if injector.firing(("dispatch_error",), label, size):
             raise _SimFault("dispatch_error", now + extra)
-        svc = cost.service_s(label, size) + extra
+        svc = cost.service_s(label, size) + extra + fleet.paging_stall_s()
         rep.busy_s += svc
         if fleet.dispatch_blocking:
             # CPU model: the dispatch call computes synchronously in

@@ -111,6 +111,43 @@ def concat_traces(*traces, gap_s: float = 0.0) -> list:
     return out
 
 
+def bucket_rates(trace, buckets, *, duration_s: float | None = None) -> dict:
+    """Per-bucket arrival rates of a trace: ``{bucket_size: dispatches
+    per second}`` over the trace duration, with a zero entry for every
+    rung of the ladder (full coverage — consumers can iterate the dict
+    without guarding missing rungs).
+
+    ``buckets`` is a ``serve.buckets.Buckets`` or a plain size list.
+    Each arrival is counted the way the engine would dispatch it: a
+    batch above the cap is split with ``Buckets.chunks`` and every span
+    lands in its own bucket, so the rates describe *dispatch* pressure
+    per compiled shape, not raw arrival counts.  ``duration_s`` defaults
+    to the last arrival's timestamp (1.0 s floor, so a burst at t=0
+    still yields finite rates).
+
+    Deterministic: a pure function of (trace, buckets) — the offline
+    twin of ``SchemeRouter.arrival_rates`` (the EWMA live estimator the
+    ``GranulePrefetcher`` consumes), and the trace summary
+    ``tune_router`` records next to its tuned ladder."""
+    from .buckets import Buckets
+    bk = buckets if isinstance(buckets, Buckets) else Buckets(buckets)
+    counts = {s: 0 for s in bk.sizes}
+    t_last = 0.0
+    for a in trace:
+        if isinstance(a, Arrival):
+            t_last = max(t_last, a.t)
+            b = a.batch
+        else:
+            b = int(a)
+        for lo, hi in bk.chunks(b):
+            counts[bk.bucket_for(hi - lo)] += 1
+    if duration_s is None:
+        duration_s = max(t_last, 1.0)
+    if duration_s <= 0:
+        raise ValueError("duration_s must be > 0 (got %r)" % (duration_s,))
+    return {s: c / duration_s for s, c in counts.items()}
+
+
 def _draw_batch(rng, lo: int, hi: int) -> int:
     """Log-uniform batch size in [lo, hi]: small batches must be common
     enough to exercise the lower ladder rungs, big ones common enough

@@ -325,3 +325,358 @@ class TableRegistry:
                 % (len(st["tables"]), st["resident_bytes"] / 2 ** 20,
                    "inf" if self.budget_bytes is None
                    else "%.1f" % (self.budget_bytes / 2 ** 20)))
+
+    def granule_store(self, name: str, version: int | None = None, *,
+                      granule: int, budget_bytes: int | None = None
+                      ) -> "GranuleStore":
+        """Granule-level residency over one registered version's table
+        (the big-table tier: residency finer than whole-table LRU).
+        The store pages the binary-GGM PERMUTED layout — the same bytes
+        a ``ClusterShardServer`` granule holds, the layout
+        ``eval_leaf_range_local`` contracts — so a paged partial eval
+        is bit-identical to the always-resident one."""
+        from ..core import expand
+        with self._lock:
+            tv = self._get(name, version)
+            srv = tv.servers["logn"]
+            perm = expand.permute_table(
+                np.asarray(srv.table, dtype=np.int32))
+            return GranuleStore(perm, granule,
+                                budget_bytes=budget_bytes,
+                                name="%s@v%d" % (tv.name, tv.version))
+
+
+# --------------------------------------------------- granule residency
+
+#: granule-store counter names (all monotonic)
+GRANULE_COUNTER_NAMES = ("promotions", "demotions", "evictions",
+                         "deferred_demotions", "hits", "misses",
+                         "prefetches", "prefetch_hits",
+                         "prefetch_misses", "overcommits")
+
+
+class GranuleLease:
+    """A pinned acquisition of one device-resident granule (context
+    manager) — the granule-level twin of ``TableLease``.  While held,
+    the granule cannot be demoted out from under an in-flight partial
+    eval: pressure marks it ``demote_pending`` and the demotion runs at
+    the last release.  Idempotent ``release``."""
+
+    __slots__ = ("_store", "row0", "table", "_released")
+
+    def __init__(self, store, row0, table):
+        self._store = store
+        self.row0 = row0
+        self.table = table            # the device-resident [granule, E]
+        self._released = False
+
+    def release(self) -> None:
+        if not self._released:
+            self._released = True
+            self._store._release(self.row0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class GranuleStore:
+    """Granule-level HBM residency for one permuted table.
+
+    ``TableRegistry`` arbitrates residency between whole tables; this
+    store arbitrates WITHIN one — the big-table tier where a single
+    logical table exceeds the device budget.  The host master copy (the
+    construction's permuted layout) lives in host RAM; granules —
+    contiguous ``granule``-row slices, the same unit the multi-host
+    cluster scatters — are promoted to the device on demand
+    (``lease``), ahead of demand (``prefetch``, driven by
+    ``GranulePrefetcher``), and demoted LRU-first when ``budget_bytes``
+    pressure arrives.  Promotion is ``device_put`` of the SAME host
+    bytes every time, so a granule that crosses an eviction boundary
+    mid-stream comes back bit-identical and every paged partial eval
+    matches the always-resident answer exactly.
+
+    Pinning follows the registry's lease discipline: a leased granule
+    is never demoted mid-flight (pressure defers to the last release,
+    counted as ``deferred_demotions``); when every resident granule is
+    pinned the store overcommits rather than stall serving (counted).
+    Thread-safe; every transition is a ``FLIGHT.record("registry",
+    granule=...)`` event and a counter, exported as
+    ``dpf_registry_granule*`` metrics
+    (``obs.metrics.register_granule_store``).
+    """
+
+    def __init__(self, table_perm, granule: int, *,
+                 budget_bytes: int | None = None, name: str = "table"):
+        tbl = np.asarray(table_perm, dtype=np.int32)
+        n = tbl.shape[0]
+        granule = int(granule)
+        if granule < 1 or n % granule:
+            raise ValueError("granule %d must divide %d rows"
+                             % (granule, n))
+        self.name = str(name)
+        self.granule = granule
+        self.n, self.entry_size = tbl.shape
+        self._host = np.ascontiguousarray(tbl)   # host-RAM master copy
+        self.row0s = tuple(range(0, n, granule))
+        self.granule_bytes = granule * self.entry_size * 4
+        self.budget_bytes = (None if budget_bytes is None
+                             else int(budget_bytes))
+        self._resident = {}        # row0 -> device [granule, E]
+        self._pins = {}            # row0 -> pin count
+        self._demote_pending = set()
+        self._prefetched = set()   # resident via prefetch, not yet hit
+        self._last_used = {}       # row0 -> LRU sequence
+        self._seq = 0
+        self._page_s = None        # EWMA seconds per promotion
+        self._lock = threading.RLock()
+        self.counters = {k: 0 for k in GRANULE_COUNTER_NAMES}
+        try:
+            from ..obs.metrics import register_granule_store
+            register_granule_store(self)
+        except Exception as e:  # observability must never break serving
+            note_swallowed("serve.registry.register_granule_metrics", e)
+
+    # ------------------------------------------------------- residency
+
+    def lease(self, row0: int) -> GranuleLease:
+        """Pin granule ``row0`` device-resident (demand-promoting a
+        cold one) and return its ``GranuleLease``.  A hit on a granule
+        a prefetch brought in counts ``prefetch_hits``; a demand
+        promotion counts ``prefetch_misses`` — the prefetcher's
+        scoreboard."""
+        with self._lock:
+            if row0 in self._resident:
+                self.counters["hits"] += 1
+                if row0 in self._prefetched:
+                    self._prefetched.discard(row0)
+                    self.counters["prefetch_hits"] += 1
+            else:
+                self.counters["misses"] += 1
+                self.counters["prefetch_misses"] += 1
+                self._promote(row0, prefetch=False)
+            self._pins[row0] = self._pins.get(row0, 0) + 1
+            self._touch(row0)
+            return GranuleLease(self, row0, self._resident[row0])
+
+    def prefetch(self, row0: int | None = None) -> bool:
+        """Promote one cold granule (``row0``, or the lowest cold one)
+        into FREE budget — a prefetch never evicts: paging ahead of a
+        guess must not displace granules demand is using.  Returns True
+        when a promotion happened."""
+        with self._lock:
+            if row0 is None:
+                cold = self.cold_row0s()
+                if not cold:
+                    return False
+                row0 = cold[0]
+            if row0 in self._resident:
+                return False
+            if (self.budget_bytes is not None
+                    and self.resident_bytes + self.granule_bytes
+                    > self.budget_bytes):
+                return False
+            self._promote(row0, prefetch=True)
+            self._prefetched.add(row0)
+            self.counters["prefetches"] += 1
+            self._touch(row0)
+            return True
+
+    def demote(self, row0: int) -> bool:
+        """Demote one granule to host-RAM-only residency (its bytes
+        stay in the master copy — demotion just drops the device
+        buffer).  Pinned granules defer to the last release.  Returns
+        True when the demotion happened now."""
+        with self._lock:
+            return self._demote(row0, action="granule_demote")
+
+    def demote_all(self) -> int:
+        """Demote every unpinned resident granule (registry-level
+        pressure: another table claimed the device).  Returns how many
+        demoted now."""
+        with self._lock:
+            return sum(self._demote(r, action="granule_demote")
+                       for r in list(self._resident))
+
+    # ------------------------------------------------------- internals
+
+    def _touch(self, row0) -> None:
+        self._seq += 1
+        self._last_used[row0] = self._seq
+
+    def _promote(self, row0, prefetch: bool) -> None:
+        if row0 % self.granule or not 0 <= row0 < self.n:
+            raise KeyError("granule row0=%d not in store (granule=%d, "
+                           "n=%d)" % (row0, self.granule, self.n))
+        import time
+
+        import jax
+        self._ensure_budget(self.granule_bytes, keep=row0)
+        t0 = time.perf_counter()
+        arr = jax.device_put(self._host[row0:row0 + self.granule])
+        arr.block_until_ready()
+        dt = time.perf_counter() - t0
+        self._page_s = (dt if self._page_s is None
+                        else 0.25 * dt + 0.75 * self._page_s)
+        self._resident[row0] = arr
+        self._demote_pending.discard(row0)
+        self.counters["promotions"] += 1
+        self._event("granule_promote", row0, prefetch=prefetch)
+
+    def _demote(self, row0, action: str) -> bool:
+        if row0 not in self._resident:
+            return False
+        if self._pins.get(row0, 0) > 0:
+            if row0 not in self._demote_pending:
+                self._demote_pending.add(row0)
+                self.counters["deferred_demotions"] += 1
+                self._event("granule_demote_deferred", row0)
+            return False
+        del self._resident[row0]      # device buffer freed with the ref
+        self._demote_pending.discard(row0)
+        self._prefetched.discard(row0)
+        self.counters["demotions"] += 1
+        if action == "granule_evict":
+            self.counters["evictions"] += 1
+        self._event(action, row0)
+        return True
+
+    def _ensure_budget(self, need: int, keep=None) -> None:
+        if self.budget_bytes is None:
+            return
+        while self.resident_bytes + need > self.budget_bytes:
+            victims = [r for r in self._resident
+                       if self._pins.get(r, 0) == 0 and r != keep]
+            if not victims:
+                self.counters["overcommits"] += 1
+                FLIGHT.record("registry", action="granule_overcommit",
+                              store=self.name, need_bytes=int(need),
+                              resident_bytes=self.resident_bytes,
+                              budget_bytes=self.budget_bytes)
+                return
+            self._demote(min(victims,
+                             key=lambda r: self._last_used.get(r, 0)),
+                         action="granule_evict")
+
+    def _release(self, row0) -> None:
+        with self._lock:
+            self._pins[row0] = max(0, self._pins.get(row0, 0) - 1)
+            if (self._pins[row0] == 0
+                    and row0 in self._demote_pending):
+                self._demote(row0, action="granule_demote")
+
+    def _event(self, action: str, row0, **extra) -> None:
+        FLIGHT.record("registry", action=action, store=self.name,
+                      granule=int(row0),
+                      pins=self._pins.get(row0, 0),
+                      resident=len(self._resident), **extra)
+
+    # -------------------------------------------------------- plumbing
+
+    @property
+    def resident_bytes(self) -> int:
+        return len(self._resident) * self.granule_bytes
+
+    def resident_row0s(self) -> tuple:
+        with self._lock:
+            return tuple(sorted(self._resident))
+
+    def cold_row0s(self) -> tuple:
+        with self._lock:
+            return tuple(r for r in self.row0s
+                         if r not in self._resident)
+
+    @property
+    def page_s(self) -> float | None:
+        """EWMA seconds per granule promotion (None until measured) —
+        how the prefetcher sizes its between-arrivals window."""
+        return self._page_s
+
+    def stats(self) -> dict:
+        """JSON-ready store snapshot (benchmark records embed it)."""
+        with self._lock:
+            return {
+                "name": self.name,
+                "granule": self.granule,
+                "granules": len(self.row0s),
+                "granules_resident": len(self._resident),
+                "granule_bytes": self.granule_bytes,
+                "budget_bytes": self.budget_bytes,
+                "resident_bytes": self.resident_bytes,
+                "page_s_ewma": self._page_s,
+                "counters": dict(self.counters),
+            }
+
+    def __repr__(self):
+        return ("GranuleStore(%s, %d/%d granules resident, %.1f/%s MiB)"
+                % (self.name, len(self._resident), len(self.row0s),
+                   self.resident_bytes / 2 ** 20,
+                   "inf" if self.budget_bytes is None
+                   else "%.1f" % (self.budget_bytes / 2 ** 20)))
+
+
+class GranulePrefetcher:
+    """Pages cold granules in BETWEEN arrivals so the device_put cost
+    overlaps serving instead of landing on a query's critical path.
+
+    ``tick()`` runs in idle gaps (the serving loop calls it after each
+    batch resolves, or a maintenance thread calls it on a timer) and
+    promotes up to ``max_per_tick`` cold granules into free budget.
+    With ``rates_fn`` — the router's live per-bucket arrival-rate
+    estimate (``SchemeRouter.arrival_rates``, or the offline
+    ``loadgen.bucket_rates``) — the tick sizes itself to the expected
+    idle window: at total arrival rate R the next batch lands in ~1/R
+    seconds, so it schedules at most ``slack/R / page_s`` promotions
+    (measured EWMA ``GranuleStore.page_s``), never a page-in it expects
+    to collide with the next arrival.  Prefetch never evicts
+    (``GranuleStore.prefetch``), so a mis-estimated rate costs only
+    staler cold granules, never thrash."""
+
+    def __init__(self, store: GranuleStore, *, rates_fn=None,
+                 max_per_tick: int = 4, slack: float = 0.5):
+        if max_per_tick < 1:
+            raise ValueError("max_per_tick must be >= 1")
+        if not 0 < slack <= 1:
+            raise ValueError("slack must be in (0, 1] (got %r)"
+                             % (slack,))
+        self.store = store
+        self.rates_fn = rates_fn
+        self.max_per_tick = int(max_per_tick)
+        self.slack = float(slack)
+        self.ticks = 0
+        self.promoted = 0
+
+    def budget_this_tick(self) -> int:
+        """How many promotions this tick may issue: ``max_per_tick``
+        capped to what fits the expected idle window."""
+        allowed = self.max_per_tick
+        page_s = self.store.page_s
+        if self.rates_fn is not None and page_s:
+            try:
+                total_hz = sum(self.rates_fn().values())
+            except Exception as e:  # estimator must never break paging
+                note_swallowed("serve.registry.prefetch_rates", e)
+                total_hz = 0.0
+            if total_hz > 0:
+                window = self.slack / total_hz
+                allowed = min(allowed, max(1, int(window / page_s)))
+        return allowed
+
+    def tick(self) -> int:
+        """Promote cold granules (lowest row0 first — dispatch order)
+        into free budget; returns how many promotions happened."""
+        self.ticks += 1
+        done = 0
+        for _ in range(self.budget_this_tick()):
+            if not self.store.prefetch():
+                break
+            done += 1
+        self.promoted += done
+        return done
+
+    def stats(self) -> dict:
+        return {"ticks": self.ticks, "promoted": self.promoted,
+                "max_per_tick": self.max_per_tick, "slack": self.slack}

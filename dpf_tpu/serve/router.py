@@ -283,6 +283,7 @@ class SchemeRouter:
         self._obs_age = {}          # (label, bucket) -> routes at this
         #                             bucket since that label was last
         #                             OBSERVED (exploration clock)
+        self._arrivals = {}         # bucket -> (last_t, EWMA gap s)
         self.sticky, self.sticky_resolved_from = self._resolve_sticky()
         self.routed_from = self.sticky_resolved_from
         self.route_counts = {lb: 0 for lb in labels}
@@ -464,6 +465,44 @@ class SchemeRouter:
             note_swallowed("serve.router.dispatch_kernel", e)
         return {}
 
+    # ----------------------------------------- arrival-rate estimator
+
+    def note_arrival(self, bucket: int, t: float | None = None) -> None:
+        """Feed one arrival at ``bucket`` into the live per-bucket
+        arrival-rate estimator: an EWMA over inter-arrival gaps (same
+        ``ewma_alpha`` as the cost model).  ``route`` calls this on
+        every batch; ``t`` defaults to ``time.monotonic()`` — tests and
+        replays pass explicit timestamps, making the estimate a pure
+        function of the arrival sequence."""
+        if t is None:
+            t = time.monotonic()
+        prev = self._arrivals.get(bucket)
+        if prev is None:
+            self._arrivals[bucket] = (t, None)
+            return
+        last_t, gap = prev
+        new_gap = max(t - last_t, 1e-9)
+        if gap is not None:
+            new_gap = (self.ewma_alpha * new_gap
+                       + (1 - self.ewma_alpha) * gap)
+        self._arrivals[bucket] = (t, new_gap)
+
+    def arrival_rate(self, bucket: int) -> float | None:
+        """EWMA arrivals/second at ``bucket`` (None until two arrivals
+        have been seen there)."""
+        rec = self._arrivals.get(bucket)
+        return None if rec is None or rec[1] is None else 1.0 / rec[1]
+
+    def arrival_rates(self) -> dict:
+        """The live per-bucket arrival-rate estimate ``{bucket: Hz}`` —
+        what the registry's ``GranulePrefetcher`` consumes to size its
+        between-arrivals page-in window (the offline twin over a full
+        trace is ``loadgen.bucket_rates``).  Buckets seen fewer than
+        twice are omitted."""
+        return {bk: 1.0 / gap
+                for bk, (_, gap) in sorted(self._arrivals.items())
+                if gap is not None}
+
     def dispatch_kernel(self, lb: str, bucket: int) -> str | None:
         """The bare ``kernel_impl`` of :meth:`dispatch_kernel_info`
         (kept as the EWMA cost-table metrics label so a relay-TPU
@@ -496,6 +535,7 @@ class SchemeRouter:
         with span("route", batch=batch):
             bucket = (self.buckets.bucket_for(batch)
                       if batch <= self.buckets.max else self.buckets.max)
+            self.note_arrival(bucket)
             avail = self._available(exclude)
             costs = {lb: self._costs.get((lb, bucket)) for lb in avail}
             if all(c is not None for c in costs.values()):
@@ -709,6 +749,9 @@ class SchemeRouter:
                 "%s@%d" % (lb, bk): round(s * 1e3, 4)
                 for (lb, bk), s in sorted(self._costs.items())},
             "buckets": list(self.buckets.sizes),
+            "arrival_rate_hz": {
+                "%d" % bk: round(hz, 4)
+                for bk, hz in self.arrival_rates().items()},
             "counters": self.counters().as_dict(),
             "per_engine": {lb: e.stats.as_dict()
                            for lb, e in self.engines.items()},

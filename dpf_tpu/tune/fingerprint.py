@@ -56,12 +56,19 @@ def shape_key(*, n: int, entry_size: int, batch: int, prf_method: int,
 
 def mesh_tag(mesh) -> str:
     """The mesh-shape half of a mesh-path cache key:
-    ``<n_batch>x<n_table>`` for a ``parallel.sharded.make_mesh`` mesh;
-    any other axis layout (e.g. a custom batch-PIR group mesh) tags as
-    ``<axis><size>`` pairs in axis order."""
+    ``<n_batch>x<n_table>`` for a ``parallel.sharded.make_mesh`` mesh,
+    with an optional ``b<n_byte>`` suffix for the 2D row x entry-byte
+    meshes (``make_mesh_2d``) — a trivial byte axis (size 1) drops the
+    suffix, so a 2D mesh that degenerates to the 1D layout produces the
+    PRE-2D tag byte-identically and every existing cache entry keeps
+    resolving.  Any other axis layout (e.g. a custom batch-PIR group
+    mesh) tags as ``<axis><size>`` pairs in axis order."""
     shape = dict(mesh.shape)
     if set(shape) == {"batch", "table"}:
         return "%dx%d" % (shape["batch"], shape["table"])
+    if set(shape) == {"batch", "table", "byte"}:
+        tag = "%dx%d" % (shape["batch"], shape["table"])
+        return tag if shape["byte"] == 1 else tag + "b%d" % shape["byte"]
     return "x".join("%s%d" % (a, shape[a]) for a in mesh.axis_names)
 
 

@@ -324,6 +324,7 @@ def tune_router(table, *, prf_method: int = 0, cap: int | None = None,
     ``tune_serving``, an explicit trace always re-measures.
     """
     import dpf_tpu
+    from ..serve import loadgen
     from ..serve.bench_load import _batch_for, _key_pool
     from ..serve.buckets import Buckets
     from ..serve.router import LABELS, SchemeRouter, build_servers
@@ -418,6 +419,14 @@ def tune_router(table, *, prf_method: int = 0, cap: int | None = None,
             "trace": trace, "cap": cap, "reps": reps,
             "candidates_tried": tried, "rejected": rejected,
             "router_stats": stats,
+            # dispatch pressure per compiled shape under the winning
+            # ladder (the trace here is a bare size list, so these are
+            # counts, not Hz — timestamped traces get real rates from
+            # loadgen.bucket_rates directly)
+            "trace_bucket_dispatches": {
+                "%d" % bk: int(c)
+                for bk, c in loadgen.bucket_rates(
+                    trace, ladder, duration_s=1.0).items()},
         },
         "fingerprint": device_fingerprint(),
         "gated": True,  # every routed answer matched the eval_cpu oracle

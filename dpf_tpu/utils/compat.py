@@ -69,6 +69,34 @@ def has_effects_barrier() -> bool:
     return callable(getattr(jax, "effects_barrier", None))
 
 
+def device_memory_stats(device=None) -> dict | None:
+    """``Device.memory_stats()`` as a plain dict, or None.
+
+    On TPU (and CUDA) jaxlib exposes per-device allocator stats —
+    notably ``bytes_limit`` (the HBM budget XLA will allocate against)
+    and ``bytes_in_use``.  On CPU backends and older jaxlib the method
+    is missing, returns None, or raises UNIMPLEMENTED; all of those
+    collapse to a graceful ``None`` here so callers can treat "no
+    stats" as "no device memory ceiling to plan around".
+
+    ``plan/capacity.detect_hbm_budget`` seeds per-host HBM budgets from
+    this probe when available.  NOTE: unlike the other probes in this
+    module, resolving the default device initializes a backend — pass
+    an explicit ``device`` (or call only after ``force_cpu_mesh``) in
+    backend-order-sensitive code."""
+    try:
+        if device is None:
+            import jax
+            device = jax.devices()[0]
+        stats = getattr(device, "memory_stats", None)
+        if stats is None:
+            return None
+        out = stats()
+    except Exception:  # pragma: no cover - backend-specific failures
+        return None
+    return dict(out) if out else None
+
+
 def has_cpu_multiprocess() -> bool:
     """True when the CPU backend supports multi-process computations
     (cross-process collectives).  jaxlib 0.4.x's CPU client raises
